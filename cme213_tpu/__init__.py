@@ -1,0 +1,24 @@
+"""cme213_tpu — a TPU-native parallel-computing framework.
+
+A brand-new JAX / XLA / Pallas / shard_map framework providing every capability
+of the Stanford CME213 (Spring 2012) parallel-workload suite (see SURVEY.md):
+
+- ``core``    — timers, ULP comparison, op-level error barriers (reference L0,
+  ``hw/hw1/programming/mp1-util.h``).
+- ``config``  — ``params.in``-compatible config with CFL/timestep derivation
+  (reference L1, ``hw/hw2/programming/2dHeat.cu:90-228``).
+- ``grid``    — functional halo-grid abstraction with Dirichlet BCs (reference
+  L2, ``hw/hw2/programming/2dHeat.cu:230-348``).
+- ``ops``     — device op layer: elementwise ciphers, stencils (XLA + Pallas),
+  scans, segmented scans, histograms, sorts, CSR gather (reference L3).
+- ``dist``    — the distributed backend: 1-D/2-D device meshes, shard_map halo
+  exchange via ``lax.ppermute``, sync/overlapped stencil steps, multi-device
+  segmented scan (reference hw5 MPI backend, ``hw/hw5/programming/2dHeat.cpp``).
+- ``verify``  — golden host models + exact/ULP/L2-Linf checkers (reference L4).
+- ``apps``    — workload drivers: cipher, pagerank, heat2d, vigenere, sorts,
+  spmv_scan (reference L5).
+- ``bench``   — sweep drivers emitting CSV (reference L7).
+- ``native``  — host-native C++/OpenMP components (hw4 sorts).
+"""
+
+__version__ = "0.1.0"

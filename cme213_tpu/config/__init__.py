@@ -1,0 +1,3 @@
+from .params import SimParams, GridMethod
+
+__all__ = ["SimParams", "GridMethod"]

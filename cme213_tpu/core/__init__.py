@@ -1,0 +1,13 @@
+from .timing import PhaseTimer, bandwidth_gbs, gflops
+from .compare import ulp_distance, almost_equal_ulps
+from .errors import check_op, FrameworkError
+
+__all__ = [
+    "PhaseTimer",
+    "bandwidth_gbs",
+    "gflops",
+    "ulp_distance",
+    "almost_equal_ulps",
+    "check_op",
+    "FrameworkError",
+]

@@ -1,0 +1,33 @@
+"""Op-level error barriers.
+
+TPU-native analog of the reference's ``check_launch(name)`` (sync +
+``cudaGetLastError`` + abort, ``hw/hw1/programming/mp1-util.h:8-18``) and
+``MPI_SAFE_CALL`` (``hw/hw5/programming/2dHeat.cpp:45-51``).  JAX device
+errors surface lazily on materialization; ``check_op`` forces them at a named
+point so failures carry the op label, like the reference's kernel names.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class FrameworkError(RuntimeError):
+    pass
+
+
+def check_op(name: str, *arrays):
+    """Block until ``arrays`` are ready; re-raise any device error with ``name``.
+
+    Returns the arrays (single array unwrapped) so it can be used inline::
+
+        out = check_op("gpu shift cypher", shift(x))
+    """
+    try:
+        for a in arrays:
+            jax.block_until_ready(a)
+    except Exception as e:  # XlaRuntimeError et al.
+        raise FrameworkError(f"error in {name}: {e}") from e
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays

@@ -1,0 +1,111 @@
+"""Phase timers and derived-metric helpers.
+
+TPU-native analog of the reference's harness utilities: ``event_pair`` +
+``start_timer``/``stop_timer`` (CUDA-event wall-clock ms, reference
+``hw/hw1/programming/mp1-util.h:21-39``), ``omp_get_wtime`` phases
+(``hw/hw4/programming/mergesort.cpp:168-184``) and ``MPI_Wtime``
+(``hw/hw5/programming/2dHeat.cpp:832-841``).  On TPU, device work is async, so
+the timer blocks on the provided arrays (``jax.block_until_ready``) before
+reading the clock — the analog of ``cudaEventSynchronize``.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+import jax
+
+
+@dataclass
+class PhaseRecord:
+    label: str
+    ms: float
+
+
+@dataclass
+class PhaseTimer:
+    """Labeled wall-clock phase timer.
+
+    Usage::
+
+        timer = PhaseTimer()
+        with timer.phase("gpu shift cypher") as ph:
+            out = jitted(x)
+            ph.block(out)          # block_until_ready before stopping the clock
+        timer.report()
+    """
+
+    records: list[PhaseRecord] = field(default_factory=list)
+    verbose: bool = False
+
+    class _Phase:
+        def __init__(self) -> None:
+            self._blocked = []
+
+        def block(self, *arrays) -> None:
+            for a in arrays:
+                self._blocked.append(a)
+
+    @contextmanager
+    def phase(self, label: str):
+        ph = PhaseTimer._Phase()
+        start = time.perf_counter()
+        try:
+            yield ph
+        finally:
+            for a in ph._blocked:
+                jax.block_until_ready(a)
+            ms = (time.perf_counter() - start) * 1e3
+            self.records.append(PhaseRecord(label, ms))
+            if self.verbose:
+                # labeled timing printout, like stop_timer's "%s took %.1f ms"
+                print(f"{label} took {ms:.1f} ms")
+
+    def ms(self, label: str) -> float:
+        """Total milliseconds across all phases with this label."""
+        return sum(r.ms for r in self.records if r.label == label)
+
+    def last_ms(self, label: str | None = None) -> float:
+        if label is None:
+            return self.records[-1].ms
+        for r in reversed(self.records):
+            if r.label == label:
+                return r.ms
+        raise KeyError(label)
+
+    def report(self) -> str:
+        lines = [f"{r.label} took {r.ms:.1f} ms" for r in self.records]
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+
+def time_fn(fn, *args, warmup: int = 1, iters: int = 3) -> float:
+    """Best-of-N wall-clock milliseconds for a (usually jitted) function.
+
+    Runs ``warmup`` untimed calls first (absorbs compilation), then takes the
+    minimum over ``iters`` timed calls, blocking on the outputs each time.
+    """
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    best = float("inf")
+    for _ in range(iters):
+        start = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, (time.perf_counter() - start) * 1e3)
+    return best
+
+
+def bandwidth_gbs(num_bytes: int, ms: float) -> float:
+    """Effective bandwidth in GB/s given bytes moved and elapsed ms.
+
+    Byte accounting follows the reference's explicit counting style
+    (``hw/hw1/programming/analysis/pagerank.cu:47-62``).
+    """
+    return (num_bytes / 1e9) / (ms / 1e3)
+
+
+def gflops(num_flops: int, ms: float) -> float:
+    return (num_flops / 1e9) / (ms / 1e3)

@@ -1,0 +1,3 @@
+from .grid import HaloGrid, make_initial_grid, interior, save_grid_to_file
+
+__all__ = ["HaloGrid", "make_initial_grid", "interior", "save_grid_to_file"]

@@ -1,0 +1,80 @@
+"""Functional halo-grid abstraction.
+
+Re-designs the reference's ``Grid<floatType>`` (flattened halo storage with an
+imperative ping-pong ``gridState`` selector, ``hw/hw2/programming/2dHeat.cu:
+230-348``) the JAX way: the grid is an immutable ``(gy, gx)`` array; the
+"ping-pong" is functional state threading (old array in, new array out) with
+XLA buffer donation doing the double-buffer reuse (strategy P13 in SURVEY
+§2.7).  Layout matches the reference: x contiguous, y rows; element (x, y) is
+``grid[y, x]``; y=0 is the *bottom* row (reference prints top row first by
+iterating y downward, ``2dHeat.cu:283-293``).
+
+Dirichlet BCs occupy the full border band of width ``border_size`` (bottom and
+top bands first over all x, then left/right bands over all y overwriting the
+corners — same order as the reference's BC loops, ``2dHeat.cu:326-344``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import SimParams
+
+
+@dataclass(frozen=True)
+class HaloGrid:
+    """Static grid geometry (the non-array part of the reference's Grid)."""
+
+    nx: int
+    ny: int
+    border_size: int
+
+    @property
+    def gx(self) -> int:
+        return self.nx + 2 * self.border_size
+
+    @property
+    def gy(self) -> int:
+        return self.ny + 2 * self.border_size
+
+    @classmethod
+    def from_params(cls, params: SimParams) -> "HaloGrid":
+        # same validity asserts as reference Grid ctor (2dHeat.cu:312-313)
+        assert params.nx > 2 * params.border_size
+        assert params.ny > 2 * params.border_size
+        return cls(nx=params.nx, ny=params.ny, border_size=params.border_size)
+
+
+def make_initial_grid(params: SimParams, dtype=jnp.float32) -> jnp.ndarray:
+    """(gy, gx) array: interior = ic, border bands = Dirichlet BC values.
+
+    BC band order matches the reference (bottom/top bands, then left/right
+    bands overwrite the corners — ``hw/hw2/programming/2dHeat.cu:326-344``).
+    """
+    b = params.border_size
+    g = np.full((params.gy, params.gx), params.ic, dtype=np.float64)
+    g[:b, :] = params.bc_bottom
+    g[b + params.ny:, :] = params.bc_top
+    g[:, :b] = params.bc_left
+    g[:, b + params.nx:] = params.bc_right
+    return jnp.asarray(g, dtype=dtype)
+
+
+def interior(grid: jnp.ndarray, border_size: int) -> jnp.ndarray:
+    """The (ny, nx) interior view of a halo grid."""
+    b = border_size
+    return grid[b:-b, b:-b] if b else grid
+
+
+def save_grid_to_file(grid, path: str) -> None:
+    """Text dump, top row first — the format of ``Grid::saveStateToFile``
+    (``hw/hw2/programming/2dHeat.cu:283-293,350-359``): 3 significant digits,
+    width-5 fields, y descending."""
+    g = np.asarray(grid)
+    with open(path, "w") as f:
+        for y in range(g.shape[0] - 1, -1, -1):
+            f.write(" ".join(f"{v:5.3g}" for v in g[y]) + " \n")
+        f.write("\n")

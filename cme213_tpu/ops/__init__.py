@@ -1,0 +1,18 @@
+from .stencil import STENCIL_COEFFS, stencil_interior, heat_step, run_heat
+from .elementwise import (
+    shift_cipher,
+    shift_cipher_packed,
+    vigenere_shift,
+    vigenere_unshift,
+)
+
+__all__ = [
+    "STENCIL_COEFFS",
+    "stencil_interior",
+    "heat_step",
+    "run_heat",
+    "shift_cipher",
+    "shift_cipher_packed",
+    "vigenere_shift",
+    "vigenere_unshift",
+]

@@ -1,0 +1,80 @@
+"""Elementwise cipher ops — flat data parallelism + lane-packing variants.
+
+TPU-native redesign of the reference's hw1 cipher kernels: the per-byte shift
+(``hw/hw1/programming/cipher.cu:64-70``) becomes a single fused XLA op over a
+``uint8`` array; the coalesced-access widening variants (uint / uint2 loads,
+``cipher.cu:75-92``, shift packed as ``(s<<24)|(s<<16)|(s<<8)|s`` at ``:231``)
+become dtype-packing via ``lax.bitcast_convert_type`` — the same
+strategy-P2 idea (move more bytes per lane) expressed for the VPU's 8×128
+lanes.  The Thrust one-liner (``hw/hw1/solution/cipher_solution.cu:234-245``)
+is the plain ``shift_cipher`` here.
+
+Semantics: unsigned-char wrapping add, matching the host golden
+(``cipher.cu:53-60``).  Like the reference's packed kernels, the packed
+variants assume no per-byte carry overflow (byte + shift < 256) — true for
+ASCII text with the reference's shift values.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.jit, static_argnames=())
+def shift_cipher(data: jnp.ndarray, shift) -> jnp.ndarray:
+    """Per-byte wrapping shift of a uint8 array."""
+    assert data.dtype == jnp.uint8
+    return data + jnp.asarray(shift, jnp.uint8)
+
+
+@partial(jax.jit, static_argnames=("width",))
+def shift_cipher_packed(data: jnp.ndarray, shift, width: int = 4) -> jnp.ndarray:
+    """Packed-lane shift: process ``width`` bytes per lane (width ∈ {4, 8}).
+
+    ``width=4`` mirrors the uint kernel, ``width=8`` the uint2 kernel.  The
+    length must be divisible by ``width`` (the reference guarantees this by
+    replicating the corpus ×16, ``cipher.cu:148-159``).
+    """
+    assert data.dtype == jnp.uint8
+    assert width in (4, 8)
+    # width=8 is two uint32 lanes, exactly like the reference's uint2 kernel
+    # (cipher.cu:85-92 shifts .x and .y separately).
+    packed = lax.bitcast_convert_type(data.reshape(-1, width // 4, 4), jnp.uint32)
+    s = jnp.asarray(shift, jnp.uint32)
+    rep = jnp.zeros((), jnp.uint32)
+    for k in range(4):
+        rep = rep | (s << (8 * k))
+    out = packed + rep
+    return lax.bitcast_convert_type(out, jnp.uint8).reshape(-1)
+
+
+@jax.jit
+def vigenere_shift(text: jnp.ndarray, shifts: jnp.ndarray) -> jnp.ndarray:
+    """Vigenère encode over lowercase bytes with a periodic key.
+
+    The reference expresses the periodic key as a
+    ``transform_iterator(periodic_shifts_fun)`` over a counting iterator
+    (``hw/hw3/programming/create_cipher.cu:54-73,135-144``); here the gather
+    ``shifts[i % period]`` is one XLA ``take``.  ``apply_shift`` math matches:
+    ``(c - 'a' + s) % 26 + 'a'``.
+    """
+    n = text.shape[0]
+    idx = jnp.arange(n) % shifts.shape[0]
+    s = shifts[idx].astype(jnp.int32)
+    c = text.astype(jnp.int32) - ord("a")
+    return ((c + s) % 26 + ord("a")).astype(jnp.uint8)
+
+
+@jax.jit
+def vigenere_unshift(text: jnp.ndarray, shifts: jnp.ndarray) -> jnp.ndarray:
+    """Vigenère decode: inverse shift ``(c - 'a' + 26 - s) % 26 + 'a'``
+    (reference ``hw/hw3/programming/solve_cipher.cu:94-101``)."""
+    n = text.shape[0]
+    idx = jnp.arange(n) % shifts.shape[0]
+    s = shifts[idx].astype(jnp.int32)
+    c = text.astype(jnp.int32) - ord("a")
+    return ((c + 26 - s % 26) % 26 + ord("a")).astype(jnp.uint8)

@@ -1,0 +1,17 @@
+from .checkers import (
+    check_exact,
+    check_ulp,
+    l2_distance,
+    relative_linf_error,
+    CheckResult,
+)
+from . import golden
+
+__all__ = [
+    "check_exact",
+    "check_ulp",
+    "l2_distance",
+    "relative_linf_error",
+    "CheckResult",
+    "golden",
+]

@@ -1,0 +1,100 @@
+"""Host golden models (numpy) — the "embedded golden model" half of the
+reference's dual-implementation testing strategy (SURVEY §4.1).
+
+Each device pipeline in ``apps/`` has a serial/host model here, mirroring the
+reference: ``host_shift_cypher`` (``hw/hw1/programming/cipher.cu:53-60``),
+``host_graph_propagate/iterate`` (``pagerank.cu:45-67``), ``cpuComputation``
+stencils (``hw/hw2/programming/2dHeat.cu:361-428``), the OpenMP CPU golden for
+the segmented scan (``hw/hw_final/programming/fp.cu:130-152``), and
+``std::sort`` goldens for hw4.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.stencil import BORDER_FOR_ORDER, STENCIL_COEFFS
+
+
+def host_shift_cipher(data: np.ndarray, shift: int) -> np.ndarray:
+    """Wrapping unsigned-char shift (cipher.cu:53-60)."""
+    assert data.dtype == np.uint8
+    return (data + np.uint8(shift)).astype(np.uint8)
+
+
+def host_heat(u: np.ndarray, iters: int, order: int, xcfl, ycfl) -> np.ndarray:
+    """Vectorized numpy heat iteration, same expression order as the device
+    stencil (so float goldens stay within a few ULPs)."""
+    coeffs = STENCIL_COEFFS[order]
+    b = BORDER_FOR_ORDER[order]
+    u = np.array(u, copy=True)
+    gy, gx = u.shape
+    ny, nx = gy - 2 * b, gx - 2 * b
+    xcfl = u.dtype.type(xcfl)
+    ycfl = u.dtype.type(ycfl)
+    for _ in range(iters):
+        center = u[b:-b, b:-b]
+        accx = np.zeros_like(center)
+        accy = np.zeros_like(center)
+        for k, c in enumerate(coeffs):
+            c = u.dtype.type(c)
+            accx = accx + c * u[b:b + ny, k:k + nx]
+            accy = accy + c * u[k:k + ny, b:b + nx]
+        u[b:-b, b:-b] = center + xcfl * accx + ycfl * accy
+    return u
+
+
+def host_graph_propagate(indices: np.ndarray, edges: np.ndarray,
+                         rank_in: np.ndarray, inv_deg: np.ndarray) -> np.ndarray:
+    """One PageRank sweep: CSR gather + ``0.5/n + 0.5·Σ rank·inv_deg``
+    (pagerank.cu:45-56), float32 accumulation like the reference."""
+    n = rank_in.shape[0]
+    out = np.empty_like(rank_in)
+    for i in range(n):
+        j0, j1 = indices[i], indices[i + 1]
+        nbrs = edges[j0:j1]
+        out[i] = np.float32(0.5) / np.float32(n) + np.float32(0.5) * np.float32(
+            np.sum(rank_in[nbrs] * inv_deg[nbrs], dtype=np.float32)
+        )
+    return out
+
+
+def host_graph_iterate(indices, edges, rank0, inv_deg, nr_iterations: int):
+    """Ping-pong iteration (pagerank.cu:59-67); nr_iterations must be even."""
+    assert nr_iterations % 2 == 0
+    a = np.array(rank0, copy=True)
+    for _ in range(nr_iterations):
+        a = host_graph_propagate(indices, edges, a, inv_deg)
+    return a
+
+
+def host_segmented_scan(values: np.ndarray, seg_starts: np.ndarray) -> np.ndarray:
+    """Inclusive segmented sum scan; one serial cumsum per segment
+    (fp.cu:130-152 CPU golden)."""
+    out = np.empty_like(values)
+    n = values.shape[0]
+    p = seg_starts.shape[0]
+    for si in range(p):
+        lo = seg_starts[si]
+        hi = seg_starts[si + 1] if si + 1 < p else n
+        out[lo:hi] = np.cumsum(values[lo:hi], dtype=values.dtype)
+    return out
+
+
+def host_spmv_scan(a: np.ndarray, seg_starts: np.ndarray, xx: np.ndarray,
+                   iters: int, dtype=None) -> np.ndarray:
+    """Iterated multiply + segmented scan, ``a ← segscan(a·xx)`` N times
+    (fp.cu:130-152; double-precision external checker
+    ``aux/reference_spMVscan-released.cu:65-144``)."""
+    if dtype is not None:
+        a = a.astype(dtype)
+        xx = xx.astype(dtype)
+    a = np.array(a, copy=True)
+    for _ in range(iters):
+        a = host_segmented_scan(a * xx, seg_starts)
+    return a
+
+
+def host_sort(keys: np.ndarray) -> np.ndarray:
+    """``std::sort`` golden (mergesort.cpp:167-172, radixsort.cpp:180-186)."""
+    return np.sort(keys, kind="stable")

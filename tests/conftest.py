@@ -1,0 +1,18 @@
+"""Test harness: run everything on a fake 8-device CPU mesh.
+
+This preserves the reference's distributed-testing methodology — "compare an
+N-rank result against a 1-rank result" (hw5 handout §5.1, SURVEY §4.4/§4.8) —
+without cluster hardware, exactly as SURVEY §4.8 prescribes:
+``XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu``.
+
+Must run before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,50 @@
+import numpy as np
+import pytest
+
+from cme213_tpu.ops import shift_cipher, shift_cipher_packed
+from cme213_tpu.verify import check_exact, golden
+
+
+@pytest.fixture
+def text():
+    rng = np.random.default_rng(0)
+    # ASCII-ish corpus (printable range) like the reference's book text
+    return rng.integers(32, 127, size=1 << 16, dtype=np.uint8)
+
+
+def test_shift_matches_host_golden(text):
+    import jax.numpy as jnp
+
+    shift = 17
+    ref = golden.host_shift_cipher(text, shift)
+    out = np.asarray(shift_cipher(jnp.asarray(text), shift))
+    res = check_exact(ref, out, "cipher u8")
+    assert res, res.message
+
+
+@pytest.mark.parametrize("width", [4, 8])
+def test_packed_variants_match(text, width):
+    import jax.numpy as jnp
+
+    shift = 13  # no per-byte carry for printable ASCII + 13 < 256... (127+13)
+    ref = golden.host_shift_cipher(text, shift)
+    out = np.asarray(shift_cipher_packed(jnp.asarray(text), shift, width=width))
+    res = check_exact(ref, out, f"cipher packed{width}")
+    assert res, res.message
+
+
+def test_wrapping_semantics():
+    import jax.numpy as jnp
+
+    data = np.array([250, 251, 255, 0], dtype=np.uint8)
+    out = np.asarray(shift_cipher(jnp.asarray(data), 10))
+    assert (out == golden.host_shift_cipher(data, 10)).all()
+    assert out[2] == 9  # 255 + 10 wraps
+
+
+def test_encrypt_decrypt_roundtrip(text):
+    import jax.numpy as jnp
+
+    enc = shift_cipher(jnp.asarray(text), 42)
+    dec = np.asarray(shift_cipher(enc, 256 - 42))
+    assert (dec == text).all()

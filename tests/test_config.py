@@ -1,0 +1,66 @@
+import math
+
+import pytest
+
+from cme213_tpu.config import GridMethod, SimParams
+
+
+def ref_dt(order, alpha, dx, dy):
+    # reproduce calcDtCFL (2dHeat.cu:206-228) independently
+    m = 0.5 - 0.0001
+    if order == 2:
+        return m * (dx * dx * dy * dy) / (alpha * (dx * dx + dy * dy))
+    if order == 4:
+        return m * (12 * dx * dx * dy * dy) / (16 * alpha * (dx * dx + dy * dy))
+    if order == 8:
+        return m * (5040 * dx * dx * dy * dy) / (8064 * alpha * (dx * dx + dy * dy))
+
+
+@pytest.mark.parametrize("order,border", [(2, 1), (4, 2), (8, 4)])
+def test_cfl_and_geometry(order, border):
+    p = SimParams(nx=100, ny=50, lx=2.0, ly=1.0, alpha=0.3, order=order)
+    dx = 2.0 / 99
+    dy = 1.0 / 49
+    assert p.dx == pytest.approx(dx)
+    assert p.dy == pytest.approx(dy)
+    assert p.dt == pytest.approx(ref_dt(order, 0.3, dx, dy))
+    assert p.border_size == border
+    assert p.gx == 100 + 2 * border
+    assert p.gy == 50 + 2 * border
+    # CFL numbers under the stability threshold
+    if order == 2:
+        assert p.xcfl + p.ycfl < 0.5
+    assert p.xcfl > 0 and p.ycfl > 0
+
+
+def test_defaults_match_reference():
+    # simParams::simParams() defaults (2dHeat.cu:133-162)
+    p = SimParams()
+    assert (p.nx, p.ny) == (10, 10)
+    assert p.bc == (0.0, 10.0, 0.0, 10.0)
+    assert p.ic == 5.0
+    assert p.order == 2 and p.border_size == 1
+
+
+def test_unsupported_order():
+    with pytest.raises(ValueError):
+        SimParams(order=3)
+
+
+def test_file_roundtrip(tmp_path):
+    p = SimParams(nx=64, ny=32, lx=3.0, ly=2.0, alpha=0.7, iters=13, order=4,
+                  ic=2.5, bc_top=1.0, bc_left=2.0, bc_bottom=3.0, bc_right=4.0)
+    f = tmp_path / "params.in"
+    p.to_file(str(f))
+    q = SimParams.from_file(str(f))
+    assert q == p
+
+
+def test_file_roundtrip_distributed(tmp_path):
+    p = SimParams(nx=64, ny=32, order=8, grid_method=GridMethod.BLOCKS_2D,
+                  synchronous=False)
+    f = tmp_path / "params.in"
+    p.to_file(str(f), distributed=True)
+    q = SimParams.from_file(str(f), distributed=True)
+    assert q == p
+    assert q.grid_method == GridMethod.BLOCKS_2D and not q.synchronous

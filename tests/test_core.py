@@ -1,0 +1,66 @@
+import numpy as np
+import pytest
+
+from cme213_tpu.core import PhaseTimer, almost_equal_ulps, bandwidth_gbs, ulp_distance
+from cme213_tpu.core.timing import time_fn
+
+
+def test_ulp_distance_adjacent_floats():
+    a = np.float32(1.0)
+    b = np.nextafter(a, np.float32(2.0))
+    assert ulp_distance(a, b) == 1
+    assert ulp_distance(a, a) == 0
+
+
+def test_ulp_distance_across_zero():
+    # -0.0 and +0.0 are 1 apart in the two's-complement ordering the
+    # reference uses (mp1-util.h:44-61): keys are adjacent.
+    a = np.float32(-0.0)
+    b = np.float32(0.0)
+    assert ulp_distance(a, b) <= 1
+    tiny_neg = np.nextafter(np.float32(0.0), np.float32(-1.0))
+    tiny_pos = np.nextafter(np.float32(0.0), np.float32(1.0))
+    assert ulp_distance(tiny_neg, tiny_pos) <= 3
+
+
+def test_almost_equal_ulps_vector():
+    a = np.linspace(-5, 5, 101, dtype=np.float32)
+    b = a.copy()
+    for _ in range(5):
+        b = np.nextafter(b, np.float32(np.inf))
+    assert almost_equal_ulps(a, b, max_ulps=10).all()
+    assert not almost_equal_ulps(a, b, max_ulps=3).any()
+
+
+def test_ulp_distance_float64():
+    a = np.float64(3.141592653589793)
+    b = np.nextafter(a, 10.0)
+    assert ulp_distance(a, b) == 1
+
+
+def test_nan_never_equal():
+    assert not almost_equal_ulps(np.float32(np.nan), np.float32(np.nan)).any()
+
+
+def test_dtype_mismatch_raises():
+    with pytest.raises(ValueError):
+        ulp_distance(np.float32(1.0), np.float64(1.0))
+
+
+def test_phase_timer():
+    import jax.numpy as jnp
+
+    t = PhaseTimer()
+    with t.phase("add") as ph:
+        out = jnp.ones(16) + 1
+        ph.block(out)
+    assert t.ms("add") >= 0
+    assert t.last_ms("add") == t.records[-1].ms
+
+
+def test_time_fn_and_bandwidth():
+    import jax.numpy as jnp
+
+    ms = time_fn(lambda x: x + 1, jnp.ones(1024))
+    assert ms > 0
+    assert bandwidth_gbs(1e9, 1000.0) == pytest.approx(1.0)
