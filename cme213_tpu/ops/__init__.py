@@ -1,13 +1,25 @@
-from .stencil import STENCIL_COEFFS, stencil_interior, heat_step, run_heat
+from .stencil import STENCIL_COEFFS, BORDER_FOR_ORDER, stencil_interior, heat_step, run_heat
 from .elementwise import (
     shift_cipher,
     shift_cipher_packed,
     vigenere_shift,
     vigenere_unshift,
 )
+from .scan import inclusive_scan, exclusive_scan, blocked_inclusive_scan
+from .segmented import (
+    head_flags_from_starts,
+    segment_ids_from_starts,
+    segmented_scan,
+    segmented_scan_from_starts,
+    validate_segments,
+)
+from .histogram import histogram_sort, histogram_onehot, histogram_segment
+from .sort import sort, sort_pairs, radix_sort, bitonic_sort
+from .gather import csr_row_ids, pagerank_propagate, pagerank_iterate
 
 __all__ = [
     "STENCIL_COEFFS",
+    "BORDER_FOR_ORDER",
     "stencil_interior",
     "heat_step",
     "run_heat",
@@ -15,4 +27,22 @@ __all__ = [
     "shift_cipher_packed",
     "vigenere_shift",
     "vigenere_unshift",
+    "inclusive_scan",
+    "exclusive_scan",
+    "blocked_inclusive_scan",
+    "head_flags_from_starts",
+    "segment_ids_from_starts",
+    "segmented_scan",
+    "segmented_scan_from_starts",
+    "validate_segments",
+    "histogram_sort",
+    "histogram_onehot",
+    "histogram_segment",
+    "sort",
+    "sort_pairs",
+    "radix_sort",
+    "bitonic_sort",
+    "csr_row_ids",
+    "pagerank_propagate",
+    "pagerank_iterate",
 ]

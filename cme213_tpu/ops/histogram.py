@@ -1,0 +1,38 @@
+"""Histograms — three TPU-native formulations.
+
+The reference builds dense histograms two ways: sort + ``upper_bound`` binary
+search (``hw/hw3/programming/solve_cipher.cu:131-154``) and ``reduce_by_key``
+over sorted data (``hw/hw3/solution/solve_cipher_solution.cu:118-127``).  Here:
+
+- ``histogram_sort``     — the sort + searchsorted formulation (direct analog).
+- ``histogram_onehot``   — one-hot reduction; for digit histograms this is a
+  (n × nbins) matmul against ones, i.e. MXU-shaped (used by the radix sort's
+  per-block histograms, strategy P7).
+- ``histogram_segment``  — ``segment_sum`` scatter-add (reduce_by_key analog).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def histogram_sort(x: jnp.ndarray, nbins: int) -> jnp.ndarray:
+    """Sort, then count per bin via searchsorted upper bounds."""
+    xs = jnp.sort(x)
+    bounds = jnp.searchsorted(xs, jnp.arange(nbins, dtype=xs.dtype), side="right")
+    lower = jnp.concatenate([jnp.zeros((1,), bounds.dtype), bounds[:-1]])
+    return (bounds - lower).astype(jnp.int32)
+
+
+def histogram_onehot(x: jnp.ndarray, nbins: int) -> jnp.ndarray:
+    """Sum of one-hot rows (XLA fuses; MXU-friendly for blocked shapes)."""
+    oh = jax.nn.one_hot(x, nbins, dtype=jnp.int32)
+    return oh.sum(axis=tuple(range(oh.ndim - 1)))
+
+
+def histogram_segment(x: jnp.ndarray, nbins: int) -> jnp.ndarray:
+    """Scatter-add formulation (Thrust reduce_by_key analog)."""
+    ones = jnp.ones_like(x, dtype=jnp.int32)
+    return jax.ops.segment_sum(ones, x.astype(jnp.int32), num_segments=nbins)

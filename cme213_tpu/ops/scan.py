@@ -1,0 +1,52 @@
+"""Prefix scans — library-algorithm parallelism (strategy P5/P7).
+
+The reference uses scans in two shapes: a serial exclusive scan over radix
+buckets (``hw/hw4/programming/radixsort.cpp:75-108``) and the
+block-decomposed upsweep/scan/downsweep pattern (per-block partials → global
+scan → per-block bases) in the parallel radix sort — the classic
+Blelloch/Sengupta structure (``my-refs/scan.pdf``).  On TPU the flat scan is
+``jax.lax.associative_scan`` (log-depth, XLA-fused); the *blocked* scan is
+kept as a first-class shape because it is exactly the multi-device scan story
+(per-shard scan + carry exchange, see ``dist/scan.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def inclusive_scan(x: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    # lax.cumsum has a dedicated fast lowering (associative_scan's generic
+    # slice-recursion compiles pathologically slowly for ragged sizes)
+    return lax.cumsum(x, axis=axis)
+
+
+def exclusive_scan(x: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    """Exclusive sum scan (identity first), as the radix bucket scan
+    (radixsort.cpp:75-83)."""
+    zero_shape = list(x.shape)
+    zero_shape[axis] = 1
+    zero = jnp.zeros(zero_shape, x.dtype)
+    shifted = lax.concatenate(
+        [zero, lax.slice_in_dim(x, 0, x.shape[axis] - 1, axis=axis)],
+        dimension=axis,
+    )
+    return lax.cumsum(shifted, axis=axis)
+
+
+def blocked_inclusive_scan(x: jnp.ndarray, block_size: int) -> jnp.ndarray:
+    """Inclusive scan via the 3-phase block decomposition.
+
+    Phase structure mirrors the reference radix pass (radixsort.cpp:44-108):
+    (1) per-block local scans, (2) scan of block totals, (3) broadcast-add of
+    block bases.  Requires ``len(x) % block_size == 0`` (drivers pad).
+    """
+    n = x.shape[0]
+    assert n % block_size == 0, "pad to a multiple of block_size"
+    blocks = x.reshape(n // block_size, block_size)
+    local = lax.cumsum(blocks, axis=1)
+    totals = local[:, -1]
+    bases = exclusive_scan(totals)
+    return (local + bases[:, None]).reshape(n)

@@ -1,0 +1,122 @@
+"""Sorts — TPU-resident pipelines.
+
+The reference's hw4 sorts are host-native OpenMP (the C++/OpenMP parity
+component lives in ``cme213_tpu/native``); these are the TPU-resident
+redesigns promised in SURVEY §7:
+
+- ``radix_sort``   — LSD radix sort with the reference's exact 4-phase pass
+  structure (``hw/hw4/programming/radixsort.cpp:22-121``): (1) per-block
+  digit histograms, (2+3) exclusive scan over ``(digit, block)`` producing
+  per-block scatter bases, (4) stable scatter.  Phases 1-3 are dense
+  one-hot reductions and scans (MXU/VPU shapes); the scatter is an XLA
+  scatter.  ``num_bits`` and ``block_size`` are the same knobs the reference
+  CLI exposes (``radixsort.cpp:163-179``, defaults 8 / 8192... configurable).
+- ``bitonic_sort`` — a merge-network sort: the TPU-native analog of hw4's
+  recursive merge sort (``mergesort.cpp:31-144``).  The task-tree
+  merge becomes a data-parallel bitonic merging network (log² stages of
+  vectorized compare-exchange), which is how a "parallel merge sort" is
+  expressed for a SIMD machine with no task runtime.
+- ``sort`` / ``sort_pairs`` — ``lax.sort`` wrappers (the Thrust-analog
+  library path used by hw3 pipelines).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .scan import exclusive_scan
+
+
+def sort(keys: jnp.ndarray) -> jnp.ndarray:
+    return lax.sort(keys)
+
+
+def sort_pairs(keys: jnp.ndarray, values: jnp.ndarray):
+    return lax.sort((keys, values), num_keys=1)
+
+
+@partial(jax.jit, static_argnames=("num_bits", "block_size", "key_bits"))
+def radix_sort(keys: jnp.ndarray, num_bits: int = 8, block_size: int = 8192,
+               key_bits: int = 32) -> jnp.ndarray:
+    """LSD radix sort of uint32 keys, 4-phase block-decomposed passes.
+
+    Pads to a block multiple with 0xFFFFFFFF sentinels (dropped on return).
+    """
+    assert keys.dtype == jnp.uint32
+    n = keys.shape[0]
+    nbuckets = 1 << num_bits
+    nblocks = max(1, -(-n // block_size))
+    padded = nblocks * block_size
+    sentinel = jnp.uint32(0xFFFFFFFF)
+    data = jnp.full((padded,), sentinel, jnp.uint32).at[:n].set(keys)
+
+    def one_pass(shift, data):
+        blocks = data.reshape(nblocks, block_size)
+        digits = ((blocks >> shift) & (nbuckets - 1)).astype(jnp.int32)
+        # (1) per-block histograms — one-hot reduction over the block dim
+        oh = jax.nn.one_hot(digits, nbuckets, dtype=jnp.int32)  # (B, S, K)
+        hist = oh.sum(axis=1)                                   # (B, K)
+        # (2)+(3) global exclusive scan in (digit-major, block-minor) order:
+        # base[d, b] = start position of digit d's run from block b — the
+        # reference's bucket scan + downsweep (radixsort.cpp:75-108).
+        bases = exclusive_scan(hist.T.reshape(-1)).reshape(nbuckets, nblocks)
+        # (4) stable scatter: rank within block among equal digits
+        ranks = jnp.cumsum(oh, axis=1) - 1                      # (B, S, K)
+        my_rank = jnp.take_along_axis(ranks, digits[..., None], axis=2)[..., 0]
+        my_base = bases[digits, jnp.arange(nblocks)[:, None]]
+        pos = (my_base + my_rank).reshape(-1)
+        return jnp.zeros_like(data).at[pos].set(data.reshape(-1))
+
+    for shift in range(0, key_bits, num_bits):
+        data = one_pass(shift, data)
+    return data[:n]
+
+
+def _bitonic_merge(x: jnp.ndarray, stage_size: int) -> jnp.ndarray:
+    """Merge bitonic runs of length ``stage_size`` into sorted runs."""
+    n = x.shape[0]
+    k = stage_size
+    while k >= 2:
+        half = k // 2
+        v = x.reshape(-1, k)
+        lo = v[:, :half]
+        hi = v[:, half:]
+        new_lo = jnp.minimum(lo, hi)
+        new_hi = jnp.maximum(lo, hi)
+        x = jnp.concatenate([new_lo, new_hi], axis=1).reshape(n)
+        k = half
+    return x
+
+
+@jax.jit
+def bitonic_sort(keys: jnp.ndarray) -> jnp.ndarray:
+    """Bitonic sorting network over a power-of-2-padded array.
+
+    Each outer stage doubles the sorted-run length (the merge tree of
+    mergesort.cpp:76-144, flattened into compare-exchange sweeps); inner
+    sweeps are fully vectorized min/max over reshaped views.
+    """
+    n = keys.shape[0]
+    m = 1 << max(1, (n - 1).bit_length())
+    if keys.dtype == jnp.uint32:
+        pad_val = jnp.uint32(0xFFFFFFFF)
+    elif keys.dtype == jnp.int32:
+        pad_val = jnp.int32(2**31 - 1)
+    else:
+        pad_val = jnp.asarray(jnp.inf, keys.dtype)
+    x = jnp.full((m,), pad_val, keys.dtype).at[:n].set(keys)
+
+    size = 2
+    while size <= m:
+        # make runs of `size` bitonic: reverse every other run of size/2
+        v = x.reshape(-1, size)
+        left = v[:, : size // 2]
+        right = v[:, size // 2:][:, ::-1]
+        x = jnp.concatenate([left, right], axis=1).reshape(m)
+        x = _bitonic_merge(x, size)
+        size *= 2
+    return x[:n]

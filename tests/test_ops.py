@@ -1,0 +1,126 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cme213_tpu.ops import (
+    bitonic_sort,
+    blocked_inclusive_scan,
+    exclusive_scan,
+    histogram_onehot,
+    histogram_segment,
+    histogram_sort,
+    inclusive_scan,
+    radix_sort,
+    segment_ids_from_starts,
+    segmented_scan_from_starts,
+    sort,
+    sort_pairs,
+    validate_segments,
+)
+from cme213_tpu.verify import golden
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+# ---------- scans ----------
+
+def test_inclusive_exclusive_scan(rng):
+    x = rng.integers(0, 100, 257).astype(np.int32)
+    inc = np.asarray(inclusive_scan(jnp.asarray(x)))
+    exc = np.asarray(exclusive_scan(jnp.asarray(x)))
+    np.testing.assert_array_equal(inc, np.cumsum(x))
+    np.testing.assert_array_equal(exc, np.cumsum(x) - x)
+
+
+def test_blocked_scan_matches_flat(rng):
+    x = rng.integers(0, 10, 1024).astype(np.int32)
+    out = np.asarray(blocked_inclusive_scan(jnp.asarray(x), block_size=64))
+    np.testing.assert_array_equal(out, np.cumsum(x))
+
+
+# ---------- segmented scan ----------
+
+def _random_segments(rng, n, p):
+    starts = np.sort(rng.choice(np.arange(1, n), size=p - 1, replace=False))
+    return np.concatenate([[0], starts]).astype(np.int32)
+
+
+def test_segment_ids(rng):
+    s = np.array([0, 3, 7], dtype=np.int32)
+    ids = np.asarray(segment_ids_from_starts(jnp.asarray(s), 10))
+    np.testing.assert_array_equal(ids, [0, 0, 0, 1, 1, 1, 1, 2, 2, 2])
+
+
+def test_segmented_scan_matches_golden(rng):
+    n, p = 1000, 37
+    s = _random_segments(rng, n, p)
+    v = rng.standard_normal(n).astype(np.float32)
+    ref = golden.host_segmented_scan(v, s)
+    out = np.asarray(segmented_scan_from_starts(jnp.asarray(v), jnp.asarray(s)))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_segmented_scan_single_segment(rng):
+    v = rng.standard_normal(64).astype(np.float32)
+    s = np.array([0], dtype=np.int32)
+    out = np.asarray(segmented_scan_from_starts(jnp.asarray(v), jnp.asarray(s)))
+    np.testing.assert_allclose(out, np.cumsum(v), rtol=1e-5, atol=1e-5)
+
+
+def test_validate_segments():
+    validate_segments(np.array([0, 5, 9]), 12)
+    with pytest.raises(ValueError):
+        validate_segments(np.array([1, 5]), 12)      # s[0] != 0
+    with pytest.raises(ValueError):
+        validate_segments(np.array([0, 5, 5]), 12)   # not strictly increasing
+    with pytest.raises(ValueError):
+        validate_segments(np.array([0, 15]), 12)     # beyond end
+
+
+# ---------- histograms ----------
+
+@pytest.mark.parametrize("fn", [histogram_sort, histogram_onehot, histogram_segment])
+def test_histograms_match_numpy(rng, fn):
+    x = rng.integers(0, 26, 5000).astype(np.int32)
+    ref = np.bincount(x, minlength=26)
+    out = np.asarray(fn(jnp.asarray(x), 26))
+    np.testing.assert_array_equal(out, ref)
+
+
+# ---------- sorts ----------
+
+def test_lax_sort_wrappers(rng):
+    x = rng.integers(0, 2**31, 1000).astype(np.uint32)
+    np.testing.assert_array_equal(np.asarray(sort(jnp.asarray(x))), np.sort(x))
+    k, v = sort_pairs(jnp.asarray(x), jnp.arange(1000))
+    np.testing.assert_array_equal(np.asarray(k), np.sort(x))
+    np.testing.assert_array_equal(x[np.asarray(v)], np.sort(x))
+
+
+@pytest.mark.parametrize("n", [100, 8192, 10000])
+def test_radix_sort(rng, n):
+    x = rng.integers(0, 2**32, n, dtype=np.uint64).astype(np.uint32)
+    out = np.asarray(radix_sort(jnp.asarray(x), num_bits=8, block_size=2048))
+    np.testing.assert_array_equal(out, np.sort(x))
+
+
+def test_radix_sort_4bit(rng):
+    x = rng.integers(0, 2**32, 3000, dtype=np.uint64).astype(np.uint32)
+    out = np.asarray(radix_sort(jnp.asarray(x), num_bits=4, block_size=512))
+    np.testing.assert_array_equal(out, np.sort(x))
+
+
+@pytest.mark.parametrize("n", [1, 2, 100, 1024, 1000])
+def test_bitonic_sort(rng, n):
+    x = rng.integers(0, 2**31, n).astype(np.uint32)
+    out = np.asarray(bitonic_sort(jnp.asarray(x)))
+    np.testing.assert_array_equal(out, np.sort(x))
+
+
+def test_bitonic_sort_float(rng):
+    x = rng.standard_normal(500).astype(np.float32)
+    out = np.asarray(bitonic_sort(jnp.asarray(x)))
+    np.testing.assert_array_equal(out, np.sort(x))

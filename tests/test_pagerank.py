@@ -1,0 +1,41 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cme213_tpu.apps.pagerank import build_graph, run_pagerank
+from cme213_tpu.verify import check_ulp, golden
+
+
+def test_graph_builder_shapes():
+    g = build_graph(num_nodes=128, avg_edges=4, seed=0)
+    assert g.indices.shape == (129,)
+    assert g.indices[0] == 0
+    assert g.indices[-1] == g.edges.shape[0]
+    # cyclic out-degree pattern 1..2*avg-1 (pagerank.cu:185-204)
+    degs = np.diff(g.indices)
+    np.testing.assert_array_equal(degs, np.arange(128) % 7 + 1)
+    assert degs.min() >= 1 and degs.max() <= 2 * 4 - 1
+    assert np.allclose(g.inv_deg[degs > 0], 1.0 / degs[degs > 0])
+
+
+def test_pagerank_matches_host_golden():
+    g = build_graph(num_nodes=256, avg_edges=3, seed=1)
+    iters = 6
+    ref = golden.host_graph_iterate(g.indices, g.edges, g.rank0, g.inv_deg, iters)
+    out = run_pagerank(g, iters)
+    res = check_ulp(ref, np.asarray(out), max_ulps=10, label="pagerank")
+    assert res, res.message
+
+
+def test_pagerank_stays_finite_positive():
+    g = build_graph(num_nodes=512, avg_edges=8, seed=2)
+    out = np.asarray(run_pagerank(g, 20))
+    assert np.isfinite(out).all()
+    # every node gets at least the teleport mass 0.5/n
+    assert (out >= 0.5 / 512 - 1e-9).all()
+
+
+def test_pagerank_odd_iterations_rejected():
+    g = build_graph(num_nodes=64, avg_edges=2, seed=3)
+    with pytest.raises(AssertionError):
+        run_pagerank(g, 3)
