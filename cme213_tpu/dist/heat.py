@@ -1,0 +1,154 @@
+"""Distributed 2-D heat solve — shard_map domain decomposition.
+
+TPU-native redesign of the reference's MPI heat engine
+(``hw/hw5/programming/2dHeat.cpp``): the interior grid (ny, nx) is sharded
+over a 1-D ("y" stripes, gridMethod=1) or 2-D ("y","x" blocks, gridMethod=2)
+device mesh; each step exchanges ``border_size``-wide halos via
+``lax.ppermute`` (see ``halo.py``) and applies the order-2/4/8 stencil to the
+local block.  The whole iteration loop runs inside one ``shard_map``-of-``jit``
+so no resharding happens between steps (the functional analog of the
+reference's persistent per-rank buffers).
+
+Two step variants, selected by ``SimParams.synchronous`` exactly like the
+reference's ``syncComputation``/``asyncComputation``:
+
+- **sync** (``2dHeat.cpp:583-694``): exchange → assemble padded block →
+  stencil over the whole local interior.
+- **overlap** (``:696-815``): the stencil over the halo-independent inner
+  region is computed *from the raw block with no data dependence on the
+  ppermute results*, so XLA's scheduler can run collective-permute and inner
+  compute concurrently (the structural form of comm/compute overlap,
+  strategy P11); the four halo-adjacent bands are then computed from the
+  padded block and assembled around the inner region.
+
+Both variants are arithmetically identical per cell (same expression per
+output), so sync-vs-overlap and N-vs-1-device results match to the ULP.
+
+Corner note: the heat stencils are axis-separable (no diagonal taps), so
+corner halos are never read; the exchange order (y slabs first, then x slabs
+of the y-padded block) still fills corners with the diagonal neighbor's data,
+mirroring the reference's full-column pack buffers (``2dHeat.cpp:456-462``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import SimParams
+from ..grid import make_initial_grid, interior
+from ..ops.stencil import BORDER_FOR_ORDER, stencil_interior
+from .halo import pad_with_halos
+
+
+def _pad_axis0(block, axis_name, axis_size, border, lo_fill, hi_fill):
+    if axis_size > 1:
+        return pad_with_halos(block, axis_name, axis_size, border,
+                              lo_fill, hi_fill)
+    w = block.shape[1]
+    lo = jnp.full((border, w), lo_fill, block.dtype)
+    hi = jnp.full((border, w), hi_fill, block.dtype)
+    return jnp.concatenate([lo, block, hi], axis=0)
+
+
+def _assemble_padded(block, params: SimParams, y_size: int, x_size: int):
+    """Local block + y halos + x halos (BC fill at physical boundaries)."""
+    b = params.border_size
+    ypad = _pad_axis0(block, "y", y_size, b, params.bc_bottom, params.bc_top)
+    xpad = _pad_axis0(ypad.T, "x", x_size, b, params.bc_left, params.bc_right)
+    return xpad.T
+
+
+def _sync_local_step(block, params: SimParams, y_size: int, x_size: int):
+    padded = _assemble_padded(block, params, y_size, x_size)
+    return stencil_interior(padded, params.order, params.xcfl, params.ycfl)
+
+
+def _overlap_local_step(block, params: SimParams, y_size: int, x_size: int):
+    b = params.border_size
+    ny, nx = block.shape
+    # inner region needs no halo: computed straight from the raw block, with
+    # no dependence on the ppermute results — overlappable by the scheduler
+    # (the analog of computing the offset-2·borderSize interior while
+    # MPI_Isend/Irecv are in flight, 2dHeat.cpp:713-721)
+    inner = stencil_interior(block, params.order, params.xcfl, params.ycfl)
+    padded = _assemble_padded(block, params, y_size, x_size)
+    st = partial(stencil_interior, order=params.order, xcfl=params.xcfl,
+                 ycfl=params.ycfl)
+    # four halo-adjacent bands (2dHeat.cpp:724-745): local rows [0,b) and
+    # [ny-b,ny) full width; local cols [0,b) and [nx-b,nx) for middle rows.
+    # padded index = local index + b.
+    bottom = st(padded[0:3 * b, :])                    # rows [0, b)
+    top = st(padded[ny - b:ny + 2 * b, :])             # rows [ny-b, ny)
+    left = st(padded[b:b + ny, 0:3 * b])               # cols [0, b), mid rows
+    right = st(padded[b:b + ny, nx - b:nx + 2 * b])
+    middle = jnp.concatenate([left, inner, right], axis=1)
+    return jnp.concatenate([bottom, middle, top], axis=0)
+
+
+def distributed_heat_step(params: SimParams, mesh: Mesh, overlap: bool = False):
+    """Build the sharded single-step function ``u (ny,nx) -> u'`` (interior
+    arrays, sharded over ``mesh``)."""
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    y_size = axes.get("y", 1)
+    x_size = axes.get("x", 1)
+    spec = P("y", "x" if "x" in axes else None)
+    local = _overlap_local_step if overlap else _sync_local_step
+
+    def step(u):
+        return jax.shard_map(
+            lambda blk: local(blk, params, y_size, x_size),
+            mesh=mesh, in_specs=(spec,), out_specs=spec,
+        )(u)
+
+    return step, spec
+
+
+@partial(jax.jit, static_argnames=("params", "mesh", "iters", "overlap"),
+         donate_argnums=(0,))
+def _run(u, params, mesh, iters, overlap):
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    y_size = axes.get("y", 1)
+    x_size = axes.get("x", 1)
+    spec = P("y", "x" if "x" in axes else None)
+    local = _overlap_local_step if overlap else _sync_local_step
+
+    def sharded_loop(blk):
+        return lax.fori_loop(
+            0, iters, lambda _, g: local(g, params, y_size, x_size), blk)
+
+    return jax.shard_map(sharded_loop, mesh=mesh,
+                         in_specs=(spec,), out_specs=spec)(u)
+
+
+def run_distributed_heat(params: SimParams, mesh: Mesh,
+                         iters: int | None = None, dtype=jnp.float32,
+                         overlap: bool | None = None) -> np.ndarray:
+    """Full distributed solve.  Returns the final full halo grid (gy, gx)
+    as numpy, for direct comparison with the single-device solver and the
+    reference's per-rank ``grid{rank}_final.txt`` methodology (SURVEY §4.4).
+
+    ``overlap`` defaults to ``not params.synchronous`` (hw5 ``sync`` flag).
+    """
+    iters = params.iters if iters is None else iters
+    overlap = (not params.synchronous) if overlap is None else overlap
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if params.ny % axes.get("y", 1):
+        raise ValueError("ny must divide evenly over the y mesh axis")
+    if params.nx % axes.get("x", 1):
+        raise ValueError("nx must divide evenly over the x mesh axis")
+
+    full0 = make_initial_grid(params, dtype=dtype)
+    u0 = jnp.array(interior(full0, params.border_size))
+    spec = P("y", "x" if "x" in axes else None)
+    u0 = jax.device_put(u0, NamedSharding(mesh, spec))
+    out = _run(u0, params, mesh, iters, overlap)
+    final = np.array(make_initial_grid(params, dtype=dtype))
+    b = params.border_size
+    final[b:-b, b:-b] = np.asarray(out)
+    return final

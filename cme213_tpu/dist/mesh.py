@@ -1,0 +1,63 @@
+"""Device-mesh construction — the process-topology layer.
+
+Replaces the reference's MPI rank topology discovery (1-D stripes
+``hw/hw5/programming/2dHeat.cpp:284-307``; 2-D √P×√P grids ``:308-377``;
+launched by ``mpirun -np`` over Torque nodes, ``hw/hw5/PA5_Handout.pdf`` §4)
+with ``jax.sharding.Mesh`` axes.  Neighbor relationships are not stored — they
+are expressed per-step as ``lax.ppermute`` permutations along mesh axes (see
+``halo.py``), with physical-boundary sides detected by ``lax.axis_index``
+instead of the reference's "-1 neighbor" sentinel.
+
+On real hardware the mesh axes ride ICI; multi-host extends the same code via
+``jax.distributed.initialize`` + the global device list (ICI-vs-DCN placement
+is mesh-axis assignment, SURVEY §2.8).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..config import GridMethod
+
+
+def make_mesh_1d(num_devices: int | None = None, axis: str = "y",
+                 devices=None) -> Mesh:
+    """1-D stripe decomposition mesh (hw5 gridMethod=1)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = num_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:n]), (axis,))
+
+
+def make_mesh_2d(py: int, px: int, axes: tuple[str, str] = ("y", "x"),
+                 devices=None) -> Mesh:
+    """2-D block decomposition mesh (hw5 gridMethod=2).
+
+    The reference asserts a square rank count (``2dHeat.cpp:316``); here any
+    py×px rectangle is allowed — the constraint was an MPI bookkeeping
+    simplification, not a capability.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if py * px > len(devices):
+        raise ValueError(f"need {py * px} devices, have {len(devices)}")
+    return Mesh(np.array(devices[: py * px]).reshape(py, px), axes)
+
+
+def mesh_for_method(method: GridMethod, num_devices: int | None = None,
+                    devices=None) -> Mesh:
+    """Build the mesh a ``SimParams.grid_method`` asks for.  For BLOCKS_2D a
+    near-square py×px factorization of the device count is chosen (square
+    when the count is a perfect square, matching the reference's √P×√P)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = num_devices or len(devices)
+    if method == GridMethod.STRIPES_1D:
+        return make_mesh_1d(n, devices=devices)
+    py = int(math.isqrt(n))
+    while n % py:
+        py -= 1
+    return make_mesh_2d(py, n // py, devices=devices)

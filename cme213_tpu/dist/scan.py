@@ -1,0 +1,74 @@
+"""Multi-device segmented scan — long-sequence (context) parallelism.
+
+The reference scales scans beyond one worker with the block-scan
+decomposition: per-block partial results, a scan over block totals, then a
+downsweep (``hw/hw4/programming/radixsort.cpp:44-108``), and slides a warp
+window over arbitrarily long segments (``hw/hw_final/programming/fp.cu:
+41-59``).  This module is that same pattern at mesh scale (SURVEY §5
+"long-context"): a sequence sharded over a mesh axis is scanned per-shard,
+shard carries are combined with the segmented-scan operator across devices,
+and each shard applies its incoming carry to the elements before its first
+segment head.
+
+The carry combine is O(P) on gathered carries (``lax.all_gather`` over ICI;
+P = mesh axis size, so the unrolled prefix is tiny) — the mesh-scale
+equivalent of the serial bucket scan between the two parallel phases of the
+reference's radix pass.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.segmented import segmented_scan
+
+
+def _local_with_carry(values, flags, axis_name: str, axis_size: int):
+    local = segmented_scan(values, flags)
+    # shard carry: (last partial sum, does my shard contain a head?)
+    carry_v = local[-1]
+    carry_f = jnp.max(flags).astype(jnp.int32)
+    vs = lax.all_gather(carry_v, axis_name)      # (P,)
+    fs = lax.all_gather(carry_f, axis_name)      # (P,)
+    # exclusive prefix-combine of carries with the segmented operator,
+    # unrolled over the (small, static) mesh axis
+    prefixes_v = [jnp.zeros_like(carry_v)]
+    prefixes_f = [jnp.zeros_like(carry_f)]
+    for j in range(axis_size - 1):
+        pv, pf = prefixes_v[-1], prefixes_f[-1]
+        prefixes_v.append(vs[j] + jnp.where(fs[j] > 0, jnp.zeros_like(pv), pv))
+        prefixes_f.append(pf | fs[j])
+    idx = lax.axis_index(axis_name)
+    incoming = jnp.stack(prefixes_v)[idx]
+    # apply to elements of the incoming open segment: position i belongs to
+    # it iff no head at any position <= i (cummax of flags still 0)
+    no_head_yet = lax.cummax(flags, axis=0) == 0
+    return local + jnp.where(no_head_yet, incoming, jnp.zeros_like(incoming))
+
+
+def distributed_segmented_scan(values: jnp.ndarray, head_flags: jnp.ndarray,
+                               mesh: Mesh, axis_name: str | None = None):
+    """Segmented inclusive scan of a sequence sharded over one mesh axis.
+
+    ``len(values)`` must divide evenly over the axis.  Works under jit; the
+    result carries the same sharding as the input.
+    """
+    axis_name = axis_name or mesh.axis_names[0]
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    if values.shape[0] % axis_size:
+        raise ValueError("sequence length must divide over the mesh axis")
+    spec = P(axis_name)
+    sharding = NamedSharding(mesh, spec)
+    values = jax.device_put(values, sharding)
+    head_flags = jax.device_put(head_flags.astype(jnp.int32), sharding)
+
+    fn = jax.jit(jax.shard_map(
+        partial(_local_with_carry, axis_name=axis_name, axis_size=axis_size),
+        mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+    ))
+    return fn(values, head_flags)

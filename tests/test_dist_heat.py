@@ -1,0 +1,93 @@
+"""Distributed heat solver tests — the reference's N-rank-vs-1-rank
+methodology (hw5 handout §5.1, SURVEY §4.4) on the fake 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cme213_tpu.config import GridMethod, SimParams
+from cme213_tpu.dist import make_mesh_1d, make_mesh_2d, mesh_for_method, run_distributed_heat
+from cme213_tpu.grid import make_initial_grid
+from cme213_tpu.ops import run_heat
+from cme213_tpu.verify import check_ulp
+
+
+def single_device_reference(params, iters, dtype=jnp.float32):
+    u0 = make_initial_grid(params, dtype=dtype)
+    return np.asarray(run_heat(jnp.array(u0), iters, params.order,
+                               params.xcfl, params.ycfl))
+
+
+@pytest.mark.parametrize("order", [2, 4, 8])
+@pytest.mark.parametrize("overlap", [False, True])
+def test_1d_matches_single_device(order, overlap):
+    params = SimParams(nx=24, ny=32, order=order, iters=8)
+    mesh = make_mesh_1d(4)
+    ref = single_device_reference(params, 8)
+    out = run_distributed_heat(params, mesh, overlap=overlap)
+    res = check_ulp(ref, out, max_ulps=2,
+                    label=f"dist1d-o{order}-{'async' if overlap else 'sync'}")
+    assert res, res.message
+
+
+@pytest.mark.parametrize("overlap", [False, True])
+def test_2d_matches_single_device(overlap):
+    params = SimParams(nx=32, ny=32, order=8, iters=6)
+    mesh = make_mesh_2d(2, 2)
+    ref = single_device_reference(params, 6)
+    out = run_distributed_heat(params, mesh, overlap=overlap)
+    res = check_ulp(ref, out, max_ulps=2, label="dist2d")
+    assert res, res.message
+
+
+def test_2d_rectangular_mesh():
+    params = SimParams(nx=24, ny=32, order=4, iters=5)
+    mesh = make_mesh_2d(4, 2)
+    ref = single_device_reference(params, 5)
+    out = run_distributed_heat(params, mesh, overlap=True)
+    res = check_ulp(ref, out, max_ulps=2, label="dist2d-rect")
+    assert res, res.message
+
+
+def test_sync_equals_overlap_bitwise():
+    params = SimParams(nx=32, ny=32, order=8, iters=7)
+    mesh = make_mesh_2d(2, 2)
+    a = run_distributed_heat(params, mesh, overlap=False)
+    b = run_distributed_heat(params, mesh, overlap=True)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_one_device_mesh_matches():
+    params = SimParams(nx=16, ny=16, order=2, iters=4)
+    mesh = make_mesh_1d(1)
+    ref = single_device_reference(params, 4)
+    out = run_distributed_heat(params, mesh)
+    res = check_ulp(ref, out, max_ulps=2, label="dist-1dev")
+    assert res, res.message
+
+
+def test_mesh_for_method():
+    m1 = mesh_for_method(GridMethod.STRIPES_1D, 8)
+    assert m1.devices.shape == (8,)
+    m2 = mesh_for_method(GridMethod.BLOCKS_2D, 8)
+    assert m2.devices.shape == (2, 4)
+    m3 = mesh_for_method(GridMethod.BLOCKS_2D, 4)
+    assert m3.devices.shape == (2, 2)
+
+
+def test_uneven_shard_rejected():
+    params = SimParams(nx=24, ny=30, order=2, iters=2)
+    mesh = make_mesh_1d(4)
+    with pytest.raises(ValueError):
+        run_distributed_heat(params, mesh)
+
+
+def test_synchronous_param_selects_variant():
+    # smoke: params.synchronous=False triggers the overlap path
+    params = SimParams(nx=16, ny=16, order=2, iters=3, synchronous=False)
+    mesh = make_mesh_1d(2)
+    ref = single_device_reference(params, 3)
+    out = run_distributed_heat(params, mesh)
+    res = check_ulp(ref, out, max_ulps=2, label="dist-async-param")
+    assert res, res.message

@@ -1,0 +1,57 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cme213_tpu.dist import distributed_segmented_scan, make_mesh_1d
+from cme213_tpu.ops import head_flags_from_starts
+from cme213_tpu.verify import golden
+
+
+def _case(rng, n, p):
+    starts = np.sort(rng.choice(np.arange(1, n), size=p - 1, replace=False))
+    s = np.concatenate([[0], starts]).astype(np.int32)
+    v = rng.standard_normal(n).astype(np.float32)
+    return v, s
+
+
+@pytest.mark.parametrize("ndev", [2, 4, 8])
+def test_matches_single_device_golden(ndev):
+    rng = np.random.default_rng(0)
+    n = 1024
+    v, s = _case(rng, n, 37)
+    mesh = make_mesh_1d(ndev)
+    flags = head_flags_from_starts(jnp.asarray(s), n)
+    out = np.asarray(distributed_segmented_scan(jnp.asarray(v), flags, mesh))
+    ref = golden.host_segmented_scan(v, s)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_segment_spanning_many_shards():
+    # one giant segment: the scan must thread carries through every shard
+    n = 512
+    v = np.ones(n, dtype=np.float32)
+    s = np.array([0], dtype=np.int32)
+    mesh = make_mesh_1d(8)
+    flags = head_flags_from_starts(jnp.asarray(s), n)
+    out = np.asarray(distributed_segmented_scan(jnp.asarray(v), flags, mesh))
+    np.testing.assert_allclose(out, np.arange(1, n + 1, dtype=np.float32))
+
+
+def test_head_on_shard_boundary():
+    n = 64
+    mesh = make_mesh_1d(4)
+    v = np.ones(n, dtype=np.float32)
+    # heads exactly at shard boundaries (16, 32) and mid-shard (40)
+    s = np.array([0, 16, 32, 40], dtype=np.int32)
+    flags = head_flags_from_starts(jnp.asarray(s), n)
+    out = np.asarray(distributed_segmented_scan(jnp.asarray(v), flags, mesh))
+    ref = golden.host_segmented_scan(v, s)
+    np.testing.assert_allclose(out, ref)
+
+
+def test_uneven_length_rejected():
+    mesh = make_mesh_1d(8)
+    v = jnp.ones(100)
+    f = jnp.zeros(100, jnp.int32).at[0].set(1)
+    with pytest.raises(ValueError):
+        distributed_segmented_scan(v, f, mesh)
