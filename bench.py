@@ -1,0 +1,66 @@
+"""Headline benchmark: hw2-class 2-D heat stencil, order 8, 4000×4000, f32.
+
+Mirrors the reference's measurement: 1000-iteration hot loop, effective
+bandwidth = (1 read + 1 write) × 4 B × nx × ny per iteration (the accounting
+behind ``hw/hw2/programming/data/data.ods``; see BASELINE.md).  Baseline to
+beat: shared-memory order-8 kernel at 4000² on a GTX 580 = **23.97 GB/s**.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Extra per-phase detail goes to stderr.
+"""
+
+import json
+import sys
+import time
+
+BASELINE_GBS = 23.97  # hw2 shared-memory order-8 4000² float (BASELINE.md)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from cme213_tpu.config import SimParams
+    from cme213_tpu.grid import make_initial_grid
+    from cme213_tpu.ops import run_heat
+
+    nx = ny = 4000
+    order = 8
+    iters_timed = 200
+
+    params = SimParams(nx=nx, ny=ny, order=order, iters=1000)
+    u0 = make_initial_grid(params, dtype=jnp.float32)
+    dev = jax.devices()[0]
+    print(f"device: {dev}", file=sys.stderr)
+
+    u = jax.device_put(u0, dev)
+    # warmup / compile (runs a short loop of the same traced program)
+    w = run_heat(u, 10, order, params.xcfl, params.ycfl)
+    w.block_until_ready()
+
+    u = jax.device_put(u0, dev)
+    start = time.perf_counter()
+    out = run_heat(u, iters_timed, order, params.xcfl, params.ycfl)
+    out.block_until_ready()
+    elapsed = time.perf_counter() - start
+
+    ms_per_iter = elapsed * 1e3 / iters_timed
+    bytes_per_iter = 2 * 4 * nx * ny          # read prev + write next, f32
+    gbs = bytes_per_iter / (elapsed / iters_timed) / 1e9
+    # order-8 per point: 2 axes × (9 mul + 8 add) + center combine (2 mul,
+    # 2 add) = 38 flops
+    flops_per_iter = 38 * nx * ny
+    gfs = flops_per_iter / (elapsed / iters_timed) / 1e9
+    print(f"{ms_per_iter:.3f} ms/iter, {gbs:.2f} GB/s eff, {gfs:.2f} GF/s",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "heat2d stencil order-8 4000x4000 f32 effective bandwidth",
+        "value": round(gbs, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbs / BASELINE_GBS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+import numpy as np
+import pytest
+
+from cme213_tpu.apps import spmv_scan as sp
+from cme213_tpu.verify import golden
+
+
+def test_generate_and_validate():
+    prob = sp.generate_problem(1000, 40, 128, iters=7, seed=1)
+    prob.validate()
+    assert prob.n == 1000 and prob.p == 40 and prob.q == 128
+    assert prob.s[0] == 0 and prob.s[-1] == 1000
+
+
+def test_file_roundtrip(tmp_path):
+    prob = sp.generate_problem(200, 10, 32, iters=3, seed=2)
+    a, x = str(tmp_path / "a.txt"), str(tmp_path / "x.txt")
+    sp.save_problem(prob, a, x)
+    loaded = sp.load_problem(a, x)
+    np.testing.assert_allclose(loaded.a, prob.a, rtol=1e-6)
+    np.testing.assert_array_equal(loaded.s, prob.s)
+    np.testing.assert_array_equal(loaded.k, prob.k)
+    np.testing.assert_allclose(loaded.x, prob.x, rtol=1e-6)
+    assert loaded.iters == prob.iters
+
+
+def test_validate_rejects_bad_segments():
+    prob = sp.generate_problem(100, 8, 16, iters=2)
+    prob.s[-1] = 99  # break the end sentinel
+    with pytest.raises(ValueError):
+        prob.validate()
+
+
+def test_matches_cpu_golden_small():
+    prob = sp.generate_problem(500, 20, 64, iters=5, seed=3)
+    out = sp.run_spmv_scan(prob)
+    ref = golden.host_spmv_scan(prob.a, prob.s[:-1], prob.xx, prob.iters)
+    # accumulating float pipeline: reference uses abs tol 1e-2 (fp.cu:193)
+    np.testing.assert_allclose(out, ref, atol=1e-2)
+
+
+def test_external_double_checker():
+    prob = sp.generate_problem(2000, 100, 256, iters=10, seed=4)
+    out = sp.run_spmv_scan(prob)
+    errs = sp.external_check(prob, out)
+    # accuracy bar from the reference report: rel L2/L∞ < 1e-6..1e-3
+    assert errs["rel_l2"] < 1e-3
+    assert errs["rel_linf"] < 1e-3
+
+
+def test_single_element_segments():
+    # s = [0,1,2,...,n] → every segment length 1 → scan is identity,
+    # result = a · xx^iters
+    n = 64
+    prob = sp.generate_problem(n, n + 1, 8, iters=3, seed=5)
+    prob.s = np.arange(n + 1, dtype=np.int32)
+    prob.validate()
+    out = sp.run_spmv_scan(prob)
+    ref = prob.a * prob.xx**3
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_suite_problem_scaled():
+    prob = sp.suite_problem("jonheart", scale=0.01)
+    prob.validate()
+    out = sp.run_spmv_scan(prob)
+    assert np.isfinite(out).all()
