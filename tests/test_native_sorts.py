@@ -1,0 +1,56 @@
+import numpy as np
+import pytest
+
+native = pytest.importorskip("cme213_tpu.native")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    try:
+        from cme213_tpu.native.build import build_library
+
+        build_library()
+    except Exception as e:  # toolchain missing
+        pytest.skip(f"native build unavailable: {e}")
+
+
+@pytest.mark.parametrize("n", [0, 1, 100, 10_000, 1_000_003])
+def test_merge_sort(n):
+    rng = np.random.default_rng(n or 7)
+    x = rng.integers(-(2**31), 2**31, size=n, dtype=np.int64).astype(np.int32)
+    ref = np.sort(x)
+    out = native.merge_sort(x.copy())
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_merge_sort_thresholds():
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 1000, size=50_000).astype(np.int32)
+    ref = np.sort(x)
+    for st, mt in [(64, 64), (1024, 333), (100_000, 100_000)]:
+        np.testing.assert_array_equal(
+            native.merge_sort(x.copy(), st, mt), ref)
+
+
+@pytest.mark.parametrize("n", [0, 1, 257, 100_000])
+@pytest.mark.parametrize("num_bits", [4, 8, 11])
+def test_radix_sort(n, num_bits):
+    rng = np.random.default_rng(n + num_bits)
+    x = rng.integers(0, 2**32, size=n, dtype=np.uint64).astype(np.uint32)
+    ref = np.sort(x)
+    np.testing.assert_array_equal(native.radix_sort(x.copy(), num_bits), ref)
+
+
+def test_radix_sort_serial_matches_parallel():
+    rng = np.random.default_rng(9)
+    x = rng.integers(0, 2**32, size=65_537, dtype=np.uint64).astype(np.uint32)
+    a = native.radix_sort(x.copy())
+    b = native.radix_sort_serial(x.copy())
+    np.testing.assert_array_equal(a, b)
+
+
+def test_thread_control():
+    native.set_threads(2)
+    assert native.thread_count() == 2
+    native.set_threads(4)
+    assert native.thread_count() == 4
