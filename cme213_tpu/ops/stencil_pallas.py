@@ -1,0 +1,111 @@
+"""Pallas VMEM-tiled heat stencil — the hand-tuned kernel path.
+
+TPU-native analog of the reference's shared-memory stencil kernel
+(``gpuShared``, ``hw/hw2/programming/2dHeat.cu:466-515``): where 128×4 CUDA
+threads cooperatively staged a 128×32 halo tile into ``__shared__`` and each
+thread emitted multiple rows, here each Pallas grid step DMAs a
+``(tile_y + 2·border, gx)`` row band from HBM into a VMEM scratch buffer
+(the explicit analog of the cooperative staging), then computes a
+``(tile_y, nx)`` output tile with the same shifted-slice expression as the
+XLA path (`ops/stencil.py`) — so results are bitwise comparable.
+
+The pure-XLA path usually reaches the HBM roofline on TPU because XLA fuses
+the whole stencil into one pass; this kernel exists as (a) the explicit
+VMEM-tiling parity artifact for strategy P3, and (b) a base to hand-tune
+(e.g. fusing the iteration loop or double-buffering the band DMA).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .stencil import BORDER_FOR_ORDER, STENCIL_COEFFS
+
+
+def _make_kernel(order: int, tile_y: int, gx: int, xcfl: float, ycfl: float):
+    b = BORDER_FOR_ORDER[order]
+    coeffs = STENCIL_COEFFS[order]
+    nx = gx - 2 * b
+
+    def kernel(u_hbm, out_ref, band, sem):
+        i = pl.program_id(0)
+        # cooperative tile staging: DMA the row band (+halo) into VMEM
+        dma = pltpu.make_async_copy(
+            u_hbm.at[pl.ds(i * tile_y, tile_y + 2 * b), :], band, sem)
+        dma.start()
+        dma.wait()
+        u = band[:]
+        dtype = u.dtype
+        center = u[b:b + tile_y, b:b + nx]
+        accx = jnp.zeros_like(center)
+        accy = jnp.zeros_like(center)
+        for k, c in enumerate(coeffs):
+            c = jnp.asarray(c, dtype)
+            accx = accx + c * u[b:b + tile_y, k:k + nx]
+            accy = accy + c * u[k:k + tile_y, b:b + nx]
+        out_ref[:] = (center + jnp.asarray(xcfl, dtype) * accx
+                      + jnp.asarray(ycfl, dtype) * accy)
+
+    return kernel
+
+
+@partial(jax.jit,
+         static_argnames=("order", "xcfl", "ycfl", "tile_y", "interpret"))
+def stencil_interior_pallas(u: jnp.ndarray, order: int, xcfl: float,
+                            ycfl: float, tile_y: int = 256,
+                            interpret: bool = False) -> jnp.ndarray:
+    """New interior (ny, nx) from halo grid (gy, gx), VMEM-tiled.
+
+    ``ny`` must divide by ``tile_y`` (drivers pick a divisor; see
+    ``pick_tile``).  ``xcfl``/``ycfl`` must be concrete floats (they are
+    baked into the kernel as constants).
+    """
+    b = BORDER_FOR_ORDER[order]
+    gy, gx = u.shape
+    ny, nx = gy - 2 * b, gx - 2 * b
+    assert ny % tile_y == 0, "ny must divide by tile_y"
+    kernel = _make_kernel(order, tile_y, gx, float(xcfl), float(ycfl))
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((ny, nx), u.dtype),
+        grid=(ny // tile_y,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((tile_y, nx), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((tile_y + 2 * b, gx), u.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(u)
+
+
+def pick_tile(ny: int, target: int = 256) -> int:
+    """Largest divisor of ny not exceeding ``target``."""
+    t = min(target, ny)
+    while ny % t:
+        t -= 1
+    return t
+
+
+@partial(jax.jit,
+         static_argnames=("order", "iters", "xcfl", "ycfl", "tile_y",
+                          "interpret"),
+         donate_argnums=(0,))
+def run_heat_pallas(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
+                    tile_y: int = 256, interpret: bool = False) -> jnp.ndarray:
+    """Iterated solve using the Pallas stencil (functional ping-pong)."""
+    b = BORDER_FOR_ORDER[order]
+
+    def body(_, g):
+        new = stencil_interior_pallas(g, order, xcfl, ycfl, tile_y=tile_y,
+                                      interpret=interpret)
+        return g.at[b:-b, b:-b].set(new)
+
+    return lax.fori_loop(0, iters, body, u)

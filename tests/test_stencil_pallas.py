@@ -1,0 +1,45 @@
+"""Pallas stencil kernel vs the XLA path (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cme213_tpu.config import SimParams
+from cme213_tpu.grid import make_initial_grid
+from cme213_tpu.ops import run_heat, stencil_interior
+from cme213_tpu.ops.stencil_pallas import (
+    pick_tile,
+    run_heat_pallas,
+    stencil_interior_pallas,
+)
+
+INTERPRET = jax.devices()[0].platform != "tpu"
+
+
+@pytest.mark.parametrize("order", [2, 4, 8])
+def test_single_step_matches_xla(order):
+    p = SimParams(nx=32, ny=32, order=order)
+    u = make_initial_grid(p) + 0.01 * jnp.arange(p.gy * p.gx, dtype=jnp.float32).reshape(p.gy, p.gx)
+    ref = np.asarray(stencil_interior(u, order, p.xcfl, p.ycfl))
+    out = np.asarray(stencil_interior_pallas(
+        u, order, p.xcfl, p.ycfl, tile_y=pick_tile(p.ny, 16),
+        interpret=INTERPRET))
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_iterated_matches_xla():
+    p = SimParams(nx=24, ny=24, order=4, iters=6)
+    u0 = make_initial_grid(p)
+    ref = np.asarray(run_heat(jnp.array(u0), 6, 4, p.xcfl, p.ycfl))
+    out = np.asarray(run_heat_pallas(jnp.array(u0), 6, 4, p.xcfl, p.ycfl,
+                                     tile_y=pick_tile(p.ny, 8),
+                                     interpret=INTERPRET))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pick_tile():
+    assert pick_tile(4000, 256) == 250
+    assert pick_tile(256, 256) == 256
+    assert pick_tile(30, 16) == 15
+    assert pick_tile(7, 16) == 7
