@@ -1,0 +1,132 @@
+"""2-D heat-diffusion workload driver (reference hw2 single-device main and
+hw5 distributed main).
+
+Orchestration mirrors ``hw/hw2/programming/2dHeat.cu:674-714``: parse params
+→ build grid → save initial state → (optional) host golden → device solve
+with the XLA-fused stencil ("global memory" analog) → ULP check → device
+solve with the Pallas VMEM-tiled kernel ("shared memory" analog) → ULP check
+→ save finals, report bandwidth/GFLOPs for each.  The distributed entry
+(``run_distributed``) is the hw5 main (``2dHeat.cpp:817-851``): grid method
+and sync/async selected by the params file.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import SimParams
+from ..core import PhaseTimer, bandwidth_gbs, gflops
+from ..dist import mesh_for_method, run_distributed_heat
+from ..grid import make_initial_grid, save_grid_to_file
+from ..ops import run_heat
+from ..ops.stencil_pallas import pick_tile, run_heat_pallas
+from ..verify import check_ulp, golden
+
+# flops per interior point per iteration: 2 axes × (taps mul + taps-1 add)
+# + combine (2 mul + 2 add)
+_FLOPS_PER_POINT = {2: 2 * 5 + 4, 4: 2 * 9 + 4, 8: 2 * 17 + 4}
+
+
+@dataclass
+class HeatResult:
+    ok: bool
+    reports: list[str] = field(default_factory=list)
+
+
+def _report(params: SimParams, label: str, ms: float) -> str:
+    per_iter = ms / params.iters
+    nbytes = 2 * 4 * params.nx * params.ny
+    nflops = _FLOPS_PER_POINT[params.order] * params.nx * params.ny
+    return (f"{label}: {ms:.1f} ms total, "
+            f"{bandwidth_gbs(nbytes, per_iter):.2f} GB/s, "
+            f"{gflops(nflops, per_iter):.2f} GFLOP/s")
+
+
+def run_single(params: SimParams, check_cpu: bool = True,
+               save_files: bool = False, out_dir: str = ".") -> HeatResult:
+    timer = PhaseTimer(verbose=True)
+    u0 = make_initial_grid(params, dtype=jnp.float32)
+    if save_files:
+        save_grid_to_file(u0, f"{out_dir}/grid_init.txt")
+
+    ref = None
+    if check_cpu:
+        with timer.phase("cpu computation"):
+            ref = golden.host_heat(np.asarray(u0), params.iters, params.order,
+                                   params.xcfl, params.ycfl)
+
+    result = HeatResult(ok=True)
+
+    # XLA-fused path (the "global memory" kernel analog)
+    run_heat(jnp.array(u0), 1, params.order, params.xcfl, params.ycfl
+             ).block_until_ready()
+    with timer.phase("gpu computation global") as ph:
+        out_xla = run_heat(jnp.array(u0), params.iters, params.order,
+                           params.xcfl, params.ycfl)
+        ph.block(out_xla)
+    result.reports.append(
+        _report(params, "xla", timer.last_ms("gpu computation global")))
+
+    # Pallas VMEM-tiled path (the "shared memory" kernel analog)
+    tile = pick_tile(params.ny)
+    interpret = jax.devices()[0].platform != "tpu"
+    run_heat_pallas(jnp.array(u0), 1, params.order, params.xcfl, params.ycfl,
+                    tile_y=tile, interpret=interpret).block_until_ready()
+    with timer.phase("gpu computation shared") as ph:
+        out_pl = run_heat_pallas(jnp.array(u0), params.iters, params.order,
+                                 params.xcfl, params.ycfl, tile_y=tile,
+                                 interpret=interpret)
+        ph.block(out_pl)
+    result.reports.append(
+        _report(params, "pallas", timer.last_ms("gpu computation shared")))
+
+    for label, out in [("global", out_xla), ("shared", out_pl)]:
+        if ref is not None:
+            res = check_ulp(ref, np.asarray(out), max_ulps=10,
+                            label=f"heat-{label}")
+            if not res:
+                print(res.message)
+                result.ok = False
+        if save_files:
+            save_grid_to_file(out, f"{out_dir}/grid_final_gpu_{label}.txt")
+
+    for r in result.reports:
+        print(r)
+    return result
+
+
+def run_distributed(params: SimParams, num_devices: int | None = None,
+                    save_files: bool = False, out_dir: str = ".") -> np.ndarray:
+    """hw5 main: mesh from ``params.grid_method``, sync/overlap from
+    ``params.synchronous``; writes per-run init/final dumps like the
+    reference's per-rank files."""
+    mesh = mesh_for_method(params.grid_method, num_devices)
+    timer = PhaseTimer(verbose=True)
+    if save_files:
+        save_grid_to_file(make_initial_grid(params), f"{out_dir}/grid_init.txt")
+    with timer.phase("distributed computation"):
+        out = run_distributed_heat(params, mesh)
+    if save_files:
+        save_grid_to_file(out, f"{out_dir}/grid_final.txt")
+    return out
+
+
+def main(argv: list[str]) -> int:
+    path = argv[1] if len(argv) > 1 else "params.in"
+    distributed = "--distributed" in argv
+    params = SimParams.from_file(path, distributed=distributed)
+    if distributed:
+        run_distributed(params, save_files=True)
+        return 0
+    res = run_single(params, check_cpu=params.nx * params.ny <= 512 * 512,
+                     save_files=True)
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

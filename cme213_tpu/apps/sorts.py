@@ -1,0 +1,99 @@
+"""Sorts workload driver (reference hw4).
+
+Driver orchestration of ``hw/hw4/programming/mergesort.cpp:146-195`` and
+``radixsort.cpp:163-215``: generate random keys, run the ``std::sort``-class
+golden, run the parallel implementations, assert element-wise equality, and
+report times/throughputs.  Implementations available:
+
+- host-native OpenMP merge sort / LSD radix sort (``cme213_tpu.native``) —
+  the parity components for the reference's CPU-scaling claims;
+- TPU-resident radix and bitonic sorts (``ops/sort.py``).
+
+CLI mirrors the reference knobs: ``sort_threshold merge_threshold
+num_elements run_serial``.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from ..verify import check_exact
+
+
+def run_merge_sort(num_elements: int = 1_000_000, sort_threshold: int = 4096,
+                   merge_threshold: int = 4096, seed: int = 0) -> bool:
+    from .. import native
+
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(-(2**31), 2**31, size=num_elements,
+                        dtype=np.int64).astype(np.int32)
+    t0 = time.perf_counter()
+    golden = np.sort(keys)
+    t_std = time.perf_counter() - t0
+
+    data = keys.copy()
+    t0 = time.perf_counter()
+    native.merge_sort(data, sort_threshold, merge_threshold)
+    t_par = time.perf_counter() - t0
+    print(f"std sort: {t_std:.3f} s, parallel merge sort: {t_par:.3f} s "
+          f"({native.thread_count()} threads)")
+    res = check_exact(golden, data, "merge sort")
+    if not res:
+        print(res.message)
+    return bool(res)
+
+
+def run_radix_sort(num_elements: int = 1_000_000, num_bits: int = 8,
+                   block_size: int = 8192, run_serial: bool = True,
+                   seed: int = 0, tpu: bool = False) -> bool:
+    from .. import native
+
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, 2**32, size=num_elements,
+                        dtype=np.uint64).astype(np.uint32)
+    golden = np.sort(keys)
+    ok = True
+
+    data = keys.copy()
+    t0 = time.perf_counter()
+    native.radix_sort(data, num_bits, block_size)
+    t_par = time.perf_counter() - t0
+    print(f"parallel radix: {num_elements / t_par / 1e6:.1f}e6 elems/s "
+          f"({t_par:.3f} s, {native.thread_count()} threads)")
+    res = check_exact(golden, data, "parallel radix")
+    ok &= bool(res)
+
+    if run_serial:
+        data = keys.copy()
+        t0 = time.perf_counter()
+        native.radix_sort_serial(data, num_bits)
+        t_ser = time.perf_counter() - t0
+        print(f"serial radix: {num_elements / t_ser / 1e6:.1f}e6 elems/s")
+        ok &= bool(check_exact(golden, data, "serial radix"))
+
+    if tpu:
+        import jax.numpy as jnp
+
+        from ..ops import radix_sort as tpu_radix
+
+        out = tpu_radix(jnp.asarray(keys), num_bits=num_bits,
+                        block_size=block_size)
+        ok &= bool(check_exact(golden, np.asarray(out), "tpu radix"))
+    return ok
+
+
+def main(argv: list[str]) -> int:
+    sort_threshold = int(argv[1]) if len(argv) > 1 else 4096
+    merge_threshold = int(argv[2]) if len(argv) > 2 else 4096
+    num_elements = int(argv[3]) if len(argv) > 3 else 1_000_000
+    run_serial = bool(int(argv[4])) if len(argv) > 4 else True
+    ok = run_merge_sort(num_elements, sort_threshold, merge_threshold)
+    ok &= run_radix_sort(num_elements, run_serial=run_serial)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -1,0 +1,17 @@
+from .sweeps import (
+    cipher_vector_length_sweep,
+    pagerank_avg_edges_sweep,
+    heat_sweep,
+    sort_thread_sweep,
+    spmv_suite_sweep,
+    write_csv,
+)
+
+__all__ = [
+    "cipher_vector_length_sweep",
+    "pagerank_avg_edges_sweep",
+    "heat_sweep",
+    "sort_thread_sweep",
+    "spmv_suite_sweep",
+    "write_csv",
+]

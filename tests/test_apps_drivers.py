@@ -1,0 +1,77 @@
+"""Smoke tests for the workload drivers (L5) and sweep harness (L7)."""
+
+import numpy as np
+import pytest
+
+from cme213_tpu.apps.cipher import make_corpus, run_cipher
+from cme213_tpu.apps.heat2d import run_single, run_distributed
+from cme213_tpu.apps.sorts import run_merge_sort, run_radix_sort
+from cme213_tpu.bench import (
+    cipher_vector_length_sweep,
+    heat_sweep,
+    pagerank_avg_edges_sweep,
+    sort_thread_sweep,
+    spmv_suite_sweep,
+    write_csv,
+)
+from cme213_tpu.config import SimParams
+
+
+def test_cipher_driver():
+    assert run_cipher(make_corpus(1 << 12, seed=1), shift=5, replicate=2)
+
+
+def test_heat2d_driver(tmp_path):
+    p = SimParams(nx=40, ny=40, order=8, iters=5)
+    res = run_single(p, check_cpu=True, save_files=True, out_dir=str(tmp_path))
+    assert res.ok
+    assert (tmp_path / "grid_init.txt").exists()
+    assert (tmp_path / "grid_final_gpu_global.txt").exists()
+    assert (tmp_path / "grid_final_gpu_shared.txt").exists()
+
+
+def test_heat2d_distributed_driver(tmp_path):
+    from cme213_tpu.config import GridMethod
+
+    p = SimParams(nx=16, ny=16, order=2, iters=3,
+                  grid_method=GridMethod.BLOCKS_2D, synchronous=False)
+    out = run_distributed(p, num_devices=4, save_files=True,
+                          out_dir=str(tmp_path))
+    assert np.isfinite(out).all()
+    assert (tmp_path / "grid_final.txt").exists()
+
+
+def test_sorts_driver():
+    assert run_merge_sort(50_000)
+    assert run_radix_sort(50_000, tpu=True)
+
+
+def test_cipher_sweep_csv(tmp_path):
+    rows = cipher_vector_length_sweep(steps=2, max_bytes=1 << 16)
+    assert len(rows) == 2 and "uint2_gbs" in rows[0]
+    f = tmp_path / "c.csv"
+    write_csv(rows, str(f))
+    assert f.read_text().count("\n") == 3
+
+
+def test_pagerank_sweep():
+    rows = pagerank_avg_edges_sweep(num_nodes=2048, edges_range=range(2, 4),
+                                    iterations=4)
+    assert [r["avg_edges"] for r in rows] == [2, 3]
+    assert all(r["gbs"] > 0 for r in rows)
+
+
+def test_heat_sweep():
+    rows = heat_sweep(sizes=(32,), orders=(2,), iters=3)
+    assert {r["kernel"] for r in rows} == {"xla", "pallas"}
+
+
+def test_sort_thread_sweep():
+    rows = sort_thread_sweep(num_elements=20_000, threads=(1, 2))
+    assert len(rows) == 2
+
+
+def test_spmv_suite_sweep():
+    rows = spmv_suite_sweep(names=["jonheart", "dense2"], scale=0.01)
+    assert len(rows) == 2
+    assert all(float(r["rel_l2"]) < 1e-3 for r in rows)
