@@ -1,0 +1,73 @@
+"""MatrixMarket → SpMV-scan problem instances (the readMM.py parity path).
+
+The reference's dataset generators (``hw/hw_final/programming/aux/readMM.py``,
+``aux/fileReadMM.py``) read SuiteSparse ``.mtx`` files with SciPy and emit
+``a.txt``/``x.txt`` instances: ``a`` = the nonzero values, ``s`` = a random
+sorted subset of indices (with 0/n sentinels), ``k`` = random gather indices,
+``x`` = uniform(−1,1), ``N`` ∈ [5,100].  This module does the same with a
+dependency-free coordinate-format parser, so real SuiteSparse matrices can be
+fed to the engine when available.
+"""
+
+from __future__ import annotations
+
+import gzip
+
+import numpy as np
+
+from .spmv_scan import Problem
+
+
+def read_matrix_market(path: str):
+    """Minimal MatrixMarket coordinate parser.
+
+    Supports ``matrix coordinate (real|integer|pattern) (general|symmetric)``.
+    Returns (rows, cols, values, shape) with 0-based indices, symmetric
+    entries expanded.
+    """
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        header = f.readline().strip().lower().split()
+        if header[:2] != ["%%matrixmarket", "matrix"]:
+            raise ValueError("not a MatrixMarket matrix file")
+        if header[2] != "coordinate":
+            raise ValueError("only coordinate format supported")
+        field, sym = header[3], header[4]
+        line = f.readline()
+        while line.startswith("%"):
+            line = f.readline()
+        nr, nc, nnz = (int(v) for v in line.split())
+        data = np.loadtxt(f, ndmin=2)
+    rows = data[:, 0].astype(np.int64) - 1
+    cols = data[:, 1].astype(np.int64) - 1
+    if field == "pattern":
+        vals = np.ones(rows.shape[0], dtype=np.float32)
+    else:
+        vals = data[:, 2].astype(np.float32)
+    if sym == "symmetric":
+        off = rows != cols
+        rows, cols = (np.concatenate([rows, cols[off]]),
+                      np.concatenate([cols, rows[off]]))
+        vals = np.concatenate([vals, vals[off]])
+    return rows, cols, vals, (nr, nc)
+
+
+def problem_from_mtx(path: str, iters: int | None = None,
+                     seed: int = 0) -> Problem:
+    """readMM.py construction: values → ``a``; random sorted row-index subset
+    → ``s``; random ``k``; uniform(−1,1) ``x``; N ∈ [5,100]."""
+    rng = np.random.default_rng(seed)
+    _, _, vals, (nr, _) = read_matrix_market(path)
+    n = vals.shape[0]
+    p_interior = min(max(nr - 1, 1), n - 1)
+    interior = np.sort(rng.choice(np.arange(1, n), size=p_interior,
+                                  replace=False))
+    s = np.concatenate([[0], interior, [n]]).astype(np.int32)
+    q = max(nr, 2)
+    k = rng.integers(0, q, size=n, dtype=np.int32)
+    x = rng.uniform(-1, 1, size=q).astype(np.float32)
+    if iters is None:
+        iters = int(rng.integers(5, 101))
+    prob = Problem(vals.astype(np.float32), s, k, x, iters)
+    prob.validate()
+    return prob

@@ -197,3 +197,57 @@ def create_cipher(raw_text: np.ndarray, period: int, seed: int = 123):
     shifts = generate_key(period, seed)
     cipher = encode(clean, shifts)
     return clean, shifts, cipher
+
+
+def print_letter_frequencies(text: jnp.ndarray) -> None:
+    """Frequency-table printout in the reference's contractual format
+    ("a: .03" per line + sum, solve_cipher.cu:142-154)."""
+    hist = np.asarray(letter_histogram(text))
+    n = text.shape[0]
+    print(f"Text length: {n}\n")
+    for i in range(26):
+        print(f"{chr(_A + i)}: {hist[i] / n}")
+    print(f"\nSum of histogram: {hist.sum() / n}\n")
+
+
+def print_digraph_table(text: jnp.ndarray) -> None:
+    """Top-20 bigram printout ("kh: .001" style, solve_cipher.cu:177-182)."""
+    codes, counts = digraph_top20(text)
+    codes, counts = np.asarray(codes), np.asarray(counts)
+    total = text.shape[0] - 1
+    for c, cnt in zip(codes, counts):
+        print(f"{chr(_A + c // 26)}{chr(_A + c % 26)}:  {cnt / total}")
+
+
+def main_create(argv):
+    """CLI of create_cipher.cu:77-99: ``input.txt period`` → writes
+    ``cipher_text.txt``."""
+    path, period = argv[1], int(argv[2])
+    raw = np.fromfile(path, dtype=np.uint8)
+    clean, shifts, cipher = create_cipher(raw, period)
+    print("Key:", "".join(chr(_A + (s - 1) % 26 + 1 - 1) for s in shifts))
+    cipher.tofile("cipher_text.txt")
+    return 0
+
+
+def main_solve(argv):
+    """CLI of solve_cipher.cu:103-274: ``cipher_text.txt`` → stats tables,
+    key, and ``plain_text.txt``."""
+    cipher = np.fromfile(argv[1], dtype=np.uint8)
+    dev = jnp.asarray(cipher)
+    print_letter_frequencies(dev)
+    print_digraph_table(dev)
+    result = crack(cipher)
+    print(f"\nkeyLength: {result.key_length}")
+    key = "".join(chr(_A + (int(s) - 1) % 26) for s in ((result.shifts - 1) % 26 + 1))
+    print("\nKey:", key, "\n")
+    result.plain_text.tofile("plain_text.txt")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    if sys.argv[1] == "solve":
+        raise SystemExit(main_solve(sys.argv[1:]))
+    raise SystemExit(main_create(sys.argv))

@@ -1,0 +1,25 @@
+"""Profiling/tracing hooks.
+
+The reference's tracing is labeled phase timers around every stage plus
+offline derived metrics (SURVEY §5).  ``PhaseTimer`` covers that; this module
+adds the device-level profile the CUDA events couldn't give: a context
+manager around ``jax.profiler`` producing an XPlane trace (viewable in
+TensorBoard/Perfetto) for kernel-level overlap verification — which SURVEY §7
+calls out as the way "async" overlap must be verified on TPU.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def device_trace(log_dir: str):
+    """Capture a device profile of the enclosed block into ``log_dir``."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
