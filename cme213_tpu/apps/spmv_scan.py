@@ -145,6 +145,57 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
     return np.asarray(out)
 
 
+def run_spmv_scan_distributed(prob: Problem, mesh, dtype=jnp.float32,
+                              timer: PhaseTimer | None = None) -> np.ndarray:
+    """Mesh-parallel pipeline: the value sequence is sharded over the mesh's
+    first axis and each iteration runs the multi-device segmented scan
+    (``dist/scan.py``) — the long-sequence scaling path.  Pads to a shard
+    multiple with zero-valued, own-segment tail elements (they never affect
+    real segments)."""
+    from ..dist.scan import _local_with_carry  # sharded kernel
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    prob.validate()
+    axis = mesh.axis_names[0]
+    nshards = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    n = prob.n
+    padded = -(-n // nshards) * nshards
+    a = np.zeros(padded, dtype=np.float32)
+    a[:n] = prob.a
+    xx = np.zeros(padded, dtype=np.float32)
+    xx[:n] = prob.xx
+    flags = np.zeros(padded, dtype=np.int32)
+    flags[prob.s[:-1]] = 1
+    if padded > n:
+        flags[n] = 1  # quarantine the tail in its own segment
+
+    spec = P(axis)
+    sharding = NamedSharding(mesh, spec)
+    a_d = jax.device_put(jnp.asarray(a, dtype), sharding)
+    xx_d = jax.device_put(jnp.asarray(xx, dtype), sharding)
+    fl_d = jax.device_put(jnp.asarray(flags), sharding)
+
+    @partial(jax.jit, static_argnames=("iters",), donate_argnums=(0,))
+    def iterate(a_d, xx_d, fl_d, iters: int):
+        def sharded(a_blk, xx_blk, fl_blk):
+            def body(_, v):
+                return _local_with_carry(v * xx_blk, fl_blk,
+                                         axis_name=axis, axis_size=nshards)
+
+            return jax.lax.fori_loop(0, iters, body, a_blk)
+
+        return jax.shard_map(sharded, mesh=mesh,
+                             in_specs=(spec, spec, spec),
+                             out_specs=spec)(a_d, xx_d, fl_d)
+
+    timer = timer or PhaseTimer()
+    iterate(jnp.zeros_like(a_d), xx_d, fl_d, prob.iters).block_until_ready()
+    with timer.phase("spmv_scan_distributed") as ph:
+        out = iterate(a_d, xx_d, fl_d, prob.iters)
+        ph.block(out)
+    return np.asarray(out)[:n]
+
+
 # ------------------------------------------------------------------ checking
 
 def external_check(prob: Problem, result: np.ndarray) -> dict:

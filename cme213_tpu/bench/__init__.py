@@ -2,8 +2,10 @@ from .sweeps import (
     cipher_vector_length_sweep,
     pagerank_avg_edges_sweep,
     heat_sweep,
+    pallas_tile_sweep,
     sort_thread_sweep,
     spmv_suite_sweep,
+    transfer_bandwidth_sweep,
     write_csv,
 )
 
@@ -11,7 +13,9 @@ __all__ = [
     "cipher_vector_length_sweep",
     "pagerank_avg_edges_sweep",
     "heat_sweep",
+    "pallas_tile_sweep",
     "sort_thread_sweep",
     "spmv_suite_sweep",
+    "transfer_bandwidth_sweep",
     "write_csv",
 ]

@@ -133,6 +133,66 @@ def heat_sweep(sizes=(1000, 2000, 4000), orders=(2, 4, 8),
     return rows
 
 
+def transfer_bandwidth_sweep(sizes=(1 << 20, 1 << 24, 1 << 26)) -> list[dict]:
+    """Host↔device copy bandwidth (the reference's PCIe measurements,
+    ``analysis/PA1_Dong-Bang_Tsai.odt`` §1c — here the PCIe/ICI path to the
+    TPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    rows = []
+    dev = jax.devices()[0]
+    for n in sizes:
+        host = np.random.default_rng(0).integers(
+            0, 255, n, dtype=np.uint64).astype(np.uint8)
+        jax.device_put(host[:64], dev).block_until_ready()
+        t0 = time.perf_counter()
+        d = jax.device_put(host, dev)
+        d.block_until_ready()
+        h2d = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _ = np.asarray(d)
+        d2h = time.perf_counter() - t0
+        rows.append({
+            "bytes": n,
+            "h2d_gbs": round(n / 1e9 / h2d, 3),
+            "d2h_gbs": round(n / 1e9 / d2h, 3),
+        })
+    return rows
+
+
+def pallas_tile_sweep(size: int = 2000, order: int = 8, iters: int = 50,
+                      tiles=(40, 100, 200, 500)) -> list[dict]:
+    """Effective bandwidth vs VMEM tile height for the Pallas stencil — the
+    analog of the reference's CUDA block-size sweep
+    (``analysis/cipher_bs.cu:154-170``): the knob controlling on-chip
+    staging granularity."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import SimParams
+    from ..grid import make_initial_grid
+    from ..ops.stencil_pallas import run_heat_pallas
+
+    interpret = jax.devices()[0].platform != "tpu"
+    p = SimParams(nx=size, ny=size, order=order, iters=iters)
+    u0 = make_initial_grid(p, dtype=jnp.float32)
+    nbytes = 2 * 4 * size * size * iters
+    rows = []
+    for t in tiles:
+        if size % t:
+            continue
+        runner = lambda u: run_heat_pallas(u, iters, order, p.xcfl, p.ycfl,
+                                           tile_y=t, interpret=interpret)
+        jax.block_until_ready(runner(jnp.array(u0)))
+        t0 = time.perf_counter()
+        jax.block_until_ready(runner(jnp.array(u0)))
+        ms = (time.perf_counter() - t0) * 1e3
+        rows.append({"tile_y": t, "ms": round(ms, 2),
+                     "gbs": round(nbytes / 1e9 / (ms / 1e3), 2)})
+    return rows
+
+
 def sort_thread_sweep(num_elements: int = 1_000_000,
                       threads=(1, 2, 4, 8, 16, 32)) -> list[dict]:
     from .. import native

@@ -1,0 +1,52 @@
+"""Multi-host startup — the ``mpirun -np`` / PBS layer (strategy P12).
+
+The reference launches distributed runs with ``mpirun -np N`` under
+Torque/PBS (``hw/hw5/PA5_Handout.pdf`` §4, ``hw/hw4/programming/pa4.pbs``),
+where process placement (one rank per node vs filling nodes) controls
+interconnect traffic.  The JAX equivalent: each host process calls
+``jax.distributed.initialize``, after which ``jax.devices()`` is the global
+device list and every mesh in ``dist/mesh.py`` spans hosts transparently —
+the same workload code runs 1-device, 1-host-N-device, and N-host.
+
+Placement maps to mesh-axis ordering: axes laid out over devices on the same
+host ride ICI; axes crossing hosts ride DCN.  ``make_mesh_2d`` with the
+fast-varying axis within a host is the "fill each node first" configuration;
+a mesh built from a host-major device ordering is "one rank per node".
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def initialize_multihost(coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> None:
+    """Initialize the multi-host runtime (no-op on a single process).
+
+    Arguments default from the standard env (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID) — the analog of MPI ranks coming from
+    the launcher environment.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if num_processes <= 1:
+        return
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def process_info():
+    """(process_id, num_processes) — the MPI_Comm_rank/size analog."""
+    import jax
+
+    return jax.process_index(), jax.process_count()

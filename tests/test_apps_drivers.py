@@ -75,3 +75,38 @@ def test_spmv_suite_sweep():
     rows = spmv_suite_sweep(names=["jonheart", "dense2"], scale=0.01)
     assert len(rows) == 2
     assert all(float(r["rel_l2"]) < 1e-3 for r in rows)
+
+
+def test_transfer_bandwidth_sweep():
+    from cme213_tpu.bench import transfer_bandwidth_sweep
+
+    rows = transfer_bandwidth_sweep(sizes=(1 << 16,))
+    assert rows[0]["h2d_gbs"] > 0 and rows[0]["d2h_gbs"] > 0
+
+
+def test_pallas_tile_sweep():
+    from cme213_tpu.bench import pallas_tile_sweep
+
+    rows = pallas_tile_sweep(size=32, order=2, iters=2, tiles=(8, 16, 5))
+    # 5 doesn't divide 32 → skipped
+    assert [r["tile_y"] for r in rows] == [8, 16]
+
+
+def test_heat_checkpoint_resume_integration(tmp_path):
+    """Interrupt-and-resume equals an uninterrupted solve."""
+    import jax.numpy as jnp
+
+    from cme213_tpu.core.checkpoint import run_with_checkpoints
+    from cme213_tpu.grid import make_initial_grid
+    from cme213_tpu.ops import run_heat
+
+    p = SimParams(nx=20, ny=20, order=4, iters=12)
+    u0 = make_initial_grid(p)
+
+    def step(state, k):
+        return run_heat(jnp.asarray(state), k, p.order, p.xcfl, p.ycfl)
+
+    ck = str(tmp_path / "heat.npz")
+    out = run_with_checkpoints(step, np.asarray(u0), 12, ck, every=5)
+    ref = np.asarray(run_heat(jnp.array(u0), 12, p.order, p.xcfl, p.ycfl))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6, atol=1e-7)
