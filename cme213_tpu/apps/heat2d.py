@@ -113,6 +113,20 @@ def run_distributed(params: SimParams, num_devices: int | None = None,
         out = run_distributed_heat(params, mesh)
     if save_files:
         save_grid_to_file(out, f"{out_dir}/grid_final.txt")
+        # per-rank interior dumps, like the reference's grid{rank}_final.txt
+        # (2dHeat.cpp:549-557) — used for offline N-vs-1 diffing
+        b = params.border_size
+        interior_grid = out[b:-b, b:-b]
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        ylocal = params.ny // axes.get("y", 1)
+        xlocal = params.nx // axes.get("x", 1)
+        rank = 0
+        for yi in range(axes.get("y", 1)):
+            for xi in range(axes.get("x", 1)):
+                blockview = interior_grid[yi * ylocal:(yi + 1) * ylocal,
+                                          xi * xlocal:(xi + 1) * xlocal]
+                save_grid_to_file(blockview, f"{out_dir}/grid{rank}_final.txt")
+                rank += 1
     return out
 
 

@@ -74,6 +74,26 @@ def segmented_scan_from_starts(values: jnp.ndarray, seg_starts: jnp.ndarray) -> 
     return segmented_scan(values, flags)
 
 
+def segmented_scan_dense(values: jnp.ndarray, seg_starts: jnp.ndarray,
+                         max_seg_len: int) -> jnp.ndarray:
+    """Dense per-segment formulation — the regular-shape analog of the
+    reference's naive one-thread-per-segment kernel (``fp_old.cu:30-58``).
+
+    Scatters each segment into a row of a (p, max_seg_len) matrix, cumsums
+    along the row axis, and gathers back.  O(p·max_seg_len) work — efficient
+    only when segment lengths are balanced; kept as the performance
+    strawman/alternative, exactly the role fp_old.cu played.
+    """
+    n = values.shape[0]
+    ids = segment_ids_from_starts(seg_starts, n)
+    offs = jnp.arange(n, dtype=jnp.int32) - seg_starts[ids]
+    p = seg_starts.shape[0]
+    dense = jnp.zeros((p, max_seg_len), values.dtype)
+    dense = dense.at[ids, offs].set(values, mode="drop")
+    scanned = jnp.cumsum(dense, axis=1)
+    return scanned[ids, offs]
+
+
 def validate_segments(seg_starts, n: int, num_segments: int | None = None) -> None:
     """Host-side invariant checks, as the reference ``load()`` asserts
     (aux/mp1-util.h:128-148): strictly increasing, s[0]==0, all < n."""

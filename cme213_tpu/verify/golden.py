@@ -47,16 +47,15 @@ def host_heat(u: np.ndarray, iters: int, order: int, xcfl, ycfl) -> np.ndarray:
 def host_graph_propagate(indices: np.ndarray, edges: np.ndarray,
                          rank_in: np.ndarray, inv_deg: np.ndarray) -> np.ndarray:
     """One PageRank sweep: CSR gather + ``0.5/n + 0.5·Σ rank·inv_deg``
-    (pagerank.cu:45-56), float32 accumulation like the reference."""
+    (pagerank.cu:45-56), float32 accumulation in the same left-to-right
+    per-row order as the serial loop (``np.add.reduceat`` is sequential
+    within each segment).  Rows are never empty (degrees ≥ 1 by
+    construction), so reduceat's empty-slice caveat doesn't apply."""
     n = rank_in.shape[0]
-    out = np.empty_like(rank_in)
-    for i in range(n):
-        j0, j1 = indices[i], indices[i + 1]
-        nbrs = edges[j0:j1]
-        out[i] = np.float32(0.5) / np.float32(n) + np.float32(0.5) * np.float32(
-            np.sum(rank_in[nbrs] * inv_deg[nbrs], dtype=np.float32)
-        )
-    return out
+    contrib = (rank_in[edges] * inv_deg[edges]).astype(np.float32)
+    sums = np.add.reduceat(contrib, indices[:-1].astype(np.int64))
+    return (np.float32(0.5) / np.float32(n)
+            + np.float32(0.5) * sums).astype(np.float32)
 
 
 def host_graph_iterate(indices, edges, rank0, inv_deg, nr_iterations: int):

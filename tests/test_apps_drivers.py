@@ -39,6 +39,9 @@ def test_heat2d_distributed_driver(tmp_path):
                           out_dir=str(tmp_path))
     assert np.isfinite(out).all()
     assert (tmp_path / "grid_final.txt").exists()
+    # per-rank dumps (4 ranks on the 2x2 mesh)
+    for r in range(4):
+        assert (tmp_path / f"grid{r}_final.txt").exists()
 
 
 def test_sorts_driver():

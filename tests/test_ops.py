@@ -70,6 +70,19 @@ def test_segmented_scan_single_segment(rng):
     np.testing.assert_allclose(out, np.cumsum(v), rtol=1e-5, atol=1e-5)
 
 
+def test_segmented_scan_dense_matches(rng):
+    from cme213_tpu.ops.segmented import segmented_scan_dense
+
+    n, p = 300, 20
+    s = _random_segments(rng, n, p)
+    v = rng.standard_normal(n).astype(np.float32)
+    max_len = int(np.diff(np.concatenate([s, [n]])).max())
+    ref = golden.host_segmented_scan(v, s)
+    out = np.asarray(segmented_scan_dense(jnp.asarray(v), jnp.asarray(s),
+                                          max_len))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
 def test_validate_segments():
     validate_segments(np.array([0, 5, 9]), 12)
     with pytest.raises(ValueError):
