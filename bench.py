@@ -1,22 +1,32 @@
 """Headline benchmark: hw2-class 2-D heat stencil, order 8, 4000×4000, f32.
 
-Mirrors the reference's measurement: 1000-iteration hot loop, effective
-bandwidth = (1 read + 1 write) × 4 B × nx × ny per iteration (the accounting
-behind ``hw/hw2/programming/data/data.ods``; see BASELINE.md).  Baseline to
-beat: shared-memory order-8 kernel at 4000² on a GTX 580 = **23.97 GB/s**.
+Mirrors the reference's measurement: hot iteration loop, effective bandwidth
+= (1 read + 1 write) × 4 B × nx × ny per iteration (the accounting behind
+``hw/hw2/programming/data/data.ods``; see BASELINE.md).  Baseline to beat:
+shared-memory order-8 kernel at 4000² on a GTX 580 = **23.97 GB/s**.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Extra per-phase detail goes to stderr.
+Per-phase detail goes to stderr.
+
+The measurement runs in a child process with a watchdog: if the TPU tunnel
+is unreachable (device init can hang inside PJRT client creation, where
+Python signal handlers can't fire), the parent times out, retries, and
+finally emits a zero-valued line instead of hanging the driver.
 """
 
 import json
+import os
+import subprocess
 import sys
-import time
 
 BASELINE_GBS = 23.97  # hw2 shared-memory order-8 4000² float (BASELINE.md)
 
+_CHILD_FLAG = "--run-measurement"
 
-def main() -> None:
+
+def measure() -> None:
+    import time
+
     import jax
     import jax.numpy as jnp
 
@@ -34,8 +44,7 @@ def main() -> None:
     print(f"device: {dev}", file=sys.stderr)
 
     u = jax.device_put(u0, dev)
-    # warmup / compile (runs a short loop of the same traced program)
-    w = run_heat(u, 10, order, params.xcfl, params.ycfl)
+    w = run_heat(u, 10, order, params.xcfl, params.ycfl)  # compile/warmup
     w.block_until_ready()
 
     u = jax.device_put(u0, dev)
@@ -47,8 +56,7 @@ def main() -> None:
     ms_per_iter = elapsed * 1e3 / iters_timed
     bytes_per_iter = 2 * 4 * nx * ny          # read prev + write next, f32
     gbs = bytes_per_iter / (elapsed / iters_timed) / 1e9
-    # order-8 per point: 2 axes × (9 mul + 8 add) + center combine (2 mul,
-    # 2 add) = 38 flops
+    # order-8 per point: 2 axes × (9 mul + 8 add) + combine (2 mul, 2 add)
     flops_per_iter = 38 * nx * ny
     gfs = flops_per_iter / (elapsed / iters_timed) / 1e9
     print(f"{ms_per_iter:.3f} ms/iter, {gbs:.2f} GB/s eff, {gfs:.2f} GF/s",
@@ -59,6 +67,35 @@ def main() -> None:
         "value": round(gbs, 2),
         "unit": "GB/s",
         "vs_baseline": round(gbs / BASELINE_GBS, 3),
+    }))
+
+
+def main() -> None:
+    if _CHILD_FLAG in sys.argv:
+        measure()
+        return
+    for attempt in range(3):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), _CHILD_FLAG],
+                timeout=900, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            print(f"attempt {attempt + 1}: timed out (TPU tunnel stuck?)",
+                  file=sys.stderr)
+            continue
+        sys.stderr.write(proc.stderr)
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        if proc.returncode == 0 and lines:
+            print(lines[-1])
+            return
+        print(f"attempt {attempt + 1}: exit {proc.returncode}",
+              file=sys.stderr)
+    print(json.dumps({
+        "metric": "heat2d stencil order-8 4000x4000 f32 effective bandwidth "
+                  "(DEVICE UNAVAILABLE)",
+        "value": 0.0,
+        "unit": "GB/s",
+        "vs_baseline": 0.0,
     }))
 
 
