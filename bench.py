@@ -33,6 +33,7 @@ def measure() -> None:
     from cme213_tpu.config import SimParams
     from cme213_tpu.grid import make_initial_grid
     from cme213_tpu.ops import run_heat
+    from cme213_tpu.ops.stencil_pallas import run_heat_multistep, run_heat_pallas
 
     nx = ny = 4000
     order = 8
@@ -41,32 +42,51 @@ def measure() -> None:
     params = SimParams(nx=nx, ny=ny, order=order, iters=1000)
     u0 = make_initial_grid(params, dtype=jnp.float32)
     dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
     print(f"device: {dev}", file=sys.stderr)
 
-    u = jax.device_put(u0, dev)
-    w = run_heat(u, 10, order, params.xcfl, params.ycfl)  # compile/warmup
-    w.block_until_ready()
+    candidates = {
+        "xla": lambda u, it: run_heat(u, it, order, params.xcfl, params.ycfl),
+        "pallas": lambda u, it: run_heat_pallas(
+            u, it, order, params.xcfl, params.ycfl, tile_y=250,
+            interpret=not on_tpu),
+        "pallas-k4": lambda u, it: run_heat_multistep(
+            u, it, order, params.xcfl, params.ycfl, params.bc, k=4,
+            tile_y=200, interpret=not on_tpu),
+        "pallas-k8": lambda u, it: run_heat_multistep(
+            u, it, order, params.xcfl, params.ycfl, params.bc, k=8,
+            tile_y=200, interpret=not on_tpu),
+    }
+    if not on_tpu:  # interpret-mode pallas at 4000² would take forever
+        candidates = {"xla": candidates["xla"]}
 
-    u = jax.device_put(u0, dev)
-    start = time.perf_counter()
-    out = run_heat(u, iters_timed, order, params.xcfl, params.ycfl)
-    out.block_until_ready()
-    elapsed = time.perf_counter() - start
-
-    ms_per_iter = elapsed * 1e3 / iters_timed
     bytes_per_iter = 2 * 4 * nx * ny          # read prev + write next, f32
-    gbs = bytes_per_iter / (elapsed / iters_timed) / 1e9
-    # order-8 per point: 2 axes × (9 mul + 8 add) + combine (2 mul, 2 add)
-    flops_per_iter = 38 * nx * ny
-    gfs = flops_per_iter / (elapsed / iters_timed) / 1e9
-    print(f"{ms_per_iter:.3f} ms/iter, {gbs:.2f} GB/s eff, {gfs:.2f} GF/s",
-          file=sys.stderr)
+    flops_per_iter = 38 * nx * ny  # 2×(9 mul+8 add) + combine (2 mul, 2 add)
+    best_name, best_gbs = None, 0.0
+    for name, fn in candidates.items():
+        try:
+            jax.block_until_ready(fn(jax.device_put(u0, dev), 8))  # compile
+            u = jax.device_put(u0, dev)
+            start = time.perf_counter()
+            jax.block_until_ready(fn(u, iters_timed))
+            elapsed = time.perf_counter() - start
+        except Exception as e:
+            print(f"{name}: failed ({type(e).__name__}: {e})", file=sys.stderr)
+            continue
+        per_iter = elapsed / iters_timed
+        gbs = bytes_per_iter / per_iter / 1e9
+        gfs = flops_per_iter / per_iter / 1e9
+        print(f"{name}: {per_iter * 1e3:.3f} ms/iter, {gbs:.2f} GB/s eff, "
+              f"{gfs:.2f} GF/s", file=sys.stderr)
+        if gbs > best_gbs:
+            best_name, best_gbs = name, gbs
 
     print(json.dumps({
-        "metric": "heat2d stencil order-8 4000x4000 f32 effective bandwidth",
-        "value": round(gbs, 2),
+        "metric": "heat2d stencil order-8 4000x4000 f32 effective bandwidth "
+                  f"(best kernel: {best_name})",
+        "value": round(best_gbs, 2),
         "unit": "GB/s",
-        "vs_baseline": round(gbs / BASELINE_GBS, 3),
+        "vs_baseline": round(best_gbs / BASELINE_GBS, 3),
     }))
 
 

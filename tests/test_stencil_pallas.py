@@ -38,6 +38,37 @@ def test_iterated_matches_xla():
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("order", [2, 8])
+@pytest.mark.parametrize("k", [2, 3])
+def test_multistep_matches_xla(order, k):
+    from cme213_tpu.ops.stencil_pallas import run_heat_multistep
+
+    p = SimParams(nx=32, ny=32, order=order, iters=6)
+    iters = 6
+    u0 = make_initial_grid(p)
+    ref = np.asarray(run_heat(jnp.array(u0), iters, order, p.xcfl, p.ycfl))
+    out = np.asarray(run_heat_multistep(
+        jnp.array(u0), iters, order, p.xcfl, p.ycfl, p.bc, k=k,
+        tile_y=8, interpret=INTERPRET))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_multistep_nonuniform_state():
+    """Multi-step fusion on a non-trivial state (gradient interior)."""
+    from cme213_tpu.ops.stencil_pallas import run_heat_multistep
+
+    p = SimParams(nx=24, ny=48, order=4, iters=4)
+    u0 = np.array(make_initial_grid(p))
+    b = p.border_size
+    rng = np.random.default_rng(3)
+    u0[b:-b, b:-b] += rng.standard_normal((p.ny, p.nx)).astype(np.float32)
+    ref = np.asarray(run_heat(jnp.array(u0), 4, 4, p.xcfl, p.ycfl))
+    out = np.asarray(run_heat_multistep(
+        jnp.array(u0), 4, 4, p.xcfl, p.ycfl, p.bc, k=4, tile_y=12,
+        interpret=INTERPRET))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
 def test_pick_tile():
     assert pick_tile(4000, 256) == 250
     assert pick_tile(256, 256) == 256
