@@ -38,6 +38,10 @@ def _load():
         _lib.set_omp_threads.argtypes = [ctypes.c_int]
         _lib.omp_thread_count.restype = ctypes.c_int
         _lib.wtime_now.restype = ctypes.c_double
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        _lib.parallel_sum_omp.argtypes = [f32p, ctypes.c_long]
+        _lib.parallel_sum_omp.restype = ctypes.c_double
+        _lib.saxpy_omp.argtypes = [ctypes.c_float, f32p, f32p, ctypes.c_long]
     return _lib
 
 
@@ -67,6 +71,20 @@ def radix_sort_serial(arr: np.ndarray, num_bits: int = 8) -> np.ndarray:
     scratch = np.empty_like(arr)
     lib.radix_sort_serial(arr, scratch, arr.size, num_bits)
     return arr
+
+
+def parallel_sum(x: np.ndarray) -> float:
+    """OpenMP reduction sum over a float32 array (f64 accumulator)."""
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    return float(_load().parallel_sum_omp(x, x.size))
+
+
+def saxpy(alpha: float, x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """In-place y ← α·x + y over float32 arrays; returns ``y``."""
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    assert y.dtype == np.float32 and y.flags["C_CONTIGUOUS"]
+    _load().saxpy_omp(alpha, x, y, x.size)
+    return y
 
 
 def set_threads(n: int) -> None:

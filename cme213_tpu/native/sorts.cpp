@@ -32,6 +32,20 @@ int omp_thread_count() { return omp_get_max_threads(); }
 void set_omp_threads(int n) { omp_set_num_threads(n); }
 double wtime_now() { return omp_get_wtime(); }
 
+// Host-CPU baselines: OpenMP reduction sum and SAXPY (the canonical
+// parallel-for kernels; CPU counterpart of ops/elementwise.py's device ops).
+double parallel_sum_omp(const float* x, long n) {
+  double acc = 0.0;
+#pragma omp parallel for reduction(+ : acc) schedule(static)
+  for (long i = 0; i < n; ++i) acc += x[i];
+  return acc;
+}
+
+void saxpy_omp(float alpha, const float* x, float* y, long n) {
+#pragma omp parallel for schedule(static)
+  for (long i = 0; i < n; ++i) y[i] = alpha * x[i] + y[i];
+}
+
 }  // extern "C"
 
 namespace {

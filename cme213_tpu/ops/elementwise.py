@@ -53,6 +53,19 @@ def shift_cipher_packed(data: jnp.ndarray, shift, width: int = 4) -> jnp.ndarray
 
 
 @jax.jit
+def saxpy(alpha, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """y ← α·x + y — the canonical bandwidth-bound elementwise op (one fused
+    VPU pass)."""
+    return jnp.asarray(alpha, x.dtype) * x + y
+
+
+@jax.jit
+def parallel_sum(x: jnp.ndarray):
+    """Full reduction (tree-reduced by XLA across sublanes/lanes)."""
+    return jnp.sum(x)
+
+
+@jax.jit
 def vigenere_shift(text: jnp.ndarray, shifts: jnp.ndarray) -> jnp.ndarray:
     """Vigenère encode over lowercase bytes with a periodic key.
 

@@ -1,0 +1,55 @@
+"""Sparse matrix-vector products — CSR and ELL formats (Bell/Garland 2008).
+
+The reference's SpMV-adjacent machinery (CSR gather in PageRank,
+``hw/hw1/programming/pagerank.cu:70-83``; the Bell/Garland SpMV tech reports
+shipped in ``refs/``; the hw_final segmented-scan formulation) generalizes to
+two TPU-native SpMV kernels:
+
+- ``csr_spmv``: edge-parallel gather + ``segment_sum`` — regular and
+  XLA-fusable, like the PageRank op.
+- ``ell_spmv``: the ELLPACK formulation — a dense ``(rows, max_nnz)`` index/
+  value layout reduced over the nnz axis.  This is the TPU-sweet-spot
+  format: fully static shapes, vectorized gather, no irregularity (the same
+  reason Bell/Garland recommend ELL for wide-SIMD GPUs).
+- ``csr_to_ell``: format conversion with zero padding.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("num_rows",))
+def csr_spmv(row_ids: jnp.ndarray, col_idx: jnp.ndarray, values: jnp.ndarray,
+             x: jnp.ndarray, num_rows: int) -> jnp.ndarray:
+    """y = A·x with A given as flat (row_ids, col_idx, values) triplets
+    (row_ids precomputed from CSR offsets via ``ops.gather.csr_row_ids``)."""
+    contrib = values * x[col_idx]
+    return jax.ops.segment_sum(contrib, row_ids, num_segments=num_rows)
+
+
+@jax.jit
+def ell_spmv(ell_cols: jnp.ndarray, ell_vals: jnp.ndarray,
+             x: jnp.ndarray) -> jnp.ndarray:
+    """y = A·x with A in ELL format: ``ell_cols``/``ell_vals`` of shape
+    (rows, max_nnz), padded entries having value 0."""
+    return jnp.sum(ell_vals * x[ell_cols], axis=1)
+
+
+def csr_to_ell(indices: np.ndarray, col_idx: np.ndarray,
+               values: np.ndarray):
+    """CSR → ELL conversion (host-side, once per matrix)."""
+    counts = np.diff(indices).astype(np.int64)
+    rows = counts.shape[0]
+    width = int(counts.max()) if rows else 0
+    ell_cols = np.zeros((rows, width), dtype=np.int32)
+    ell_vals = np.zeros((rows, width), dtype=values.dtype)
+    for r in range(rows):
+        lo, hi = indices[r], indices[r + 1]
+        ell_cols[r, : hi - lo] = col_idx[lo:hi]
+        ell_vals[r, : hi - lo] = values[lo:hi]
+    return ell_cols, ell_vals

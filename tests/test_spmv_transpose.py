@@ -1,0 +1,88 @@
+"""CSR/ELL SpMV, tiled transpose, saxpy/parallel-sum (device + native)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cme213_tpu.ops.gather import csr_row_ids
+from cme213_tpu.ops.spmv import csr_spmv, csr_to_ell, ell_spmv
+from cme213_tpu.ops.transpose import transpose_pallas, transpose_xla
+
+INTERPRET = jax.devices()[0].platform != "tpu"
+
+
+def random_csr(rng, rows, cols, avg_nnz):
+    counts = rng.integers(0, 2 * avg_nnz + 1, rows)
+    indices = np.zeros(rows + 1, dtype=np.int64)
+    np.cumsum(counts, out=indices[1:])
+    nnz = int(indices[-1])
+    col_idx = rng.integers(0, cols, nnz).astype(np.int32)
+    vals = rng.standard_normal(nnz).astype(np.float32)
+    return indices, col_idx, vals
+
+
+def dense_from_csr(indices, col_idx, vals, rows, cols):
+    a = np.zeros((rows, cols), dtype=np.float32)
+    for r in range(rows):
+        for j in range(indices[r], indices[r + 1]):
+            a[r, col_idx[j]] += vals[j]
+    return a
+
+
+def test_csr_spmv_matches_dense():
+    rng = np.random.default_rng(0)
+    rows, cols = 100, 80
+    indices, col_idx, vals = random_csr(rng, rows, cols, 4)
+    x = rng.standard_normal(cols).astype(np.float32)
+    a = dense_from_csr(indices, col_idx, vals, rows, cols)
+    row_ids = csr_row_ids(jnp.asarray(indices.astype(np.int32)),
+                          col_idx.shape[0])
+    y = np.asarray(csr_spmv(row_ids, jnp.asarray(col_idx), jnp.asarray(vals),
+                            jnp.asarray(x), rows))
+    np.testing.assert_allclose(y, a @ x, rtol=1e-4, atol=1e-4)
+
+
+def test_ell_spmv_matches_csr():
+    rng = np.random.default_rng(1)
+    rows, cols = 64, 64
+    indices, col_idx, vals = random_csr(rng, rows, cols, 3)
+    x = rng.standard_normal(cols).astype(np.float32)
+    a = dense_from_csr(indices, col_idx, vals, rows, cols)
+    ell_cols, ell_vals = csr_to_ell(indices, col_idx, vals)
+    y = np.asarray(ell_spmv(jnp.asarray(ell_cols), jnp.asarray(ell_vals),
+                            jnp.asarray(x)))
+    np.testing.assert_allclose(y, a @ x, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("shape,tile", [((64, 64), 32), ((128, 64), 32)])
+def test_transpose_pallas(shape, tile):
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    out = np.asarray(transpose_pallas(x, tile=tile, interpret=INTERPRET))
+    np.testing.assert_array_equal(out, np.asarray(x).T)
+    np.testing.assert_array_equal(np.asarray(transpose_xla(x)), np.asarray(x).T)
+
+
+def test_device_saxpy_sum():
+    from cme213_tpu.ops.elementwise import parallel_sum, saxpy
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal(1000).astype(np.float32)
+    y = rng.standard_normal(1000).astype(np.float32)
+    out = np.asarray(saxpy(2.5, jnp.asarray(x), jnp.asarray(y)))
+    np.testing.assert_allclose(out, 2.5 * x + y, rtol=1e-5, atol=1e-6)
+    assert np.asarray(parallel_sum(jnp.asarray(x))) == pytest.approx(
+        x.sum(), rel=1e-4)
+
+
+def test_native_saxpy_sum():
+    from cme213_tpu import native
+
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal(10_000).astype(np.float32)
+    y = rng.standard_normal(10_000).astype(np.float32)
+    assert native.parallel_sum(x) == pytest.approx(float(x.sum()), rel=1e-6)
+    expect = 1.5 * x + y
+    native.saxpy(1.5, x, y)
+    np.testing.assert_allclose(y, expect, rtol=1e-6)
