@@ -1,0 +1,71 @@
+"""Run every sweep and write CSV artifacts (the L7 harness entry point).
+
+Usage: ``python -m cme213_tpu.bench.run_all [--out DIR] [--quick]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    from . import sweeps
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="bench_results")
+    ap.add_argument("--quick", action="store_true",
+                    help="small sizes (CI/CPU-friendly)")
+    args = ap.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    q = args.quick
+
+    jobs = [
+        ("data_bandwidth_vector_length.csv",
+         lambda: sweeps.cipher_vector_length_sweep(
+             steps=3 if q else 25, max_bytes=1 << 16 if q else 1 << 26)),
+        ("bandwidth_vs_avg_edges.csv",
+         lambda: sweeps.pagerank_avg_edges_sweep(
+             num_nodes=1 << 12 if q else 1 << 21,
+             edges_range=range(2, 5) if q else range(2, 21),
+             iterations=4 if q else 20)),
+        ("heat_bandwidth.csv",
+         lambda: sweeps.heat_sweep(
+             sizes=(64,) if q else (1000, 2000, 4000),
+             orders=(2, 4, 8), iters=3 if q else 200)),
+        ("pallas_tile.csv",
+         lambda: sweeps.pallas_tile_sweep(
+             size=32 if q else 2000, order=2 if q else 8,
+             iters=2 if q else 100,
+             tiles=(8, 16) if q else (40, 100, 200, 250, 500))),
+        ("transfer_bandwidth.csv",
+         lambda: sweeps.transfer_bandwidth_sweep(
+             sizes=(1 << 16,) if q else (1 << 20, 1 << 24, 1 << 27))),
+        ("scan_bandwidth.csv",
+         lambda: sweeps.scan_sweep(
+             n=1 << 16 if q else 1 << 26,
+             num_segments=1 << 8 if q else 1 << 16)),
+        ("sort_threads.csv",
+         lambda: sweeps.sort_thread_sweep(
+             num_elements=20_000 if q else 16_000_000,
+             threads=(1, 2) if q else (1, 2, 4, 8, 16, 32))),
+        ("spmv_suite.csv",
+         lambda: sweeps.spmv_suite_sweep(
+             scale=0.002 if q else 1.0)),
+    ]
+    for fname, job in jobs:
+        path = os.path.join(args.out, fname)
+        try:
+            rows = job()
+        except Exception as e:
+            print(f"{fname}: FAILED ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            continue
+        sweeps.write_csv(rows, path)
+        print(f"{path}: {len(rows)} rows")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -221,6 +221,43 @@ def sort_thread_sweep(num_elements: int = 1_000_000,
     return rows
 
 
+def scan_sweep(n: int = 1 << 26, num_segments: int = 1 << 16) -> list[dict]:
+    """Effective bandwidth of the scan family at 2^26 floats: plain
+    inclusive scan, segmented scan, and the tiled transpose (the
+    "transpose+scan eff. GB/s" metrics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import inclusive_scan, segmented_scan, transpose_pallas, transpose_xla
+    from ..ops.segmented import head_flags_from_starts
+
+    rng = np.random.default_rng(0)
+    v = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    starts = np.sort(rng.choice(np.arange(1, n, dtype=np.int64),
+                                size=num_segments - 1, replace=False))
+    starts = np.concatenate([[0], starts]).astype(np.int32)
+    flags = head_flags_from_starts(jnp.asarray(starts), n)
+
+    rows = []
+    ms = _time_ms(jax.jit(inclusive_scan), v)
+    rows.append({"op": "inclusive_scan", "n": n, "ms": round(ms, 2),
+                 "gbs": round(2 * 4 * n / 1e9 / (ms / 1e3), 2)})
+    ms = _time_ms(jax.jit(segmented_scan), v, flags)
+    rows.append({"op": "segmented_scan", "n": n, "ms": round(ms, 2),
+                 "gbs": round(2 * 4 * n / 1e9 / (ms / 1e3), 2)})
+
+    side = 4096
+    m = jnp.asarray(rng.standard_normal((side, side)).astype(np.float32))
+    interpret = jax.devices()[0].platform != "tpu"
+    for name, fn in [("transpose_xla", lambda x: transpose_xla(x)),
+                     ("transpose_pallas", lambda x: transpose_pallas(
+                         x, tile=256, interpret=interpret))]:
+        ms = _time_ms(fn, m)
+        rows.append({"op": name, "n": side * side, "ms": round(ms, 2),
+                     "gbs": round(2 * 4 * side * side / 1e9 / (ms / 1e3), 2)})
+    return rows
+
+
 def spmv_suite_sweep(names=None, scale: float = 0.05) -> list[dict]:
     from ..apps import spmv_scan as sp
     from ..core import PhaseTimer

@@ -16,6 +16,10 @@ from .segmented import (
 from .histogram import histogram_sort, histogram_onehot, histogram_segment
 from .sort import sort, sort_pairs, radix_sort, bitonic_sort
 from .gather import csr_row_ids, pagerank_propagate, pagerank_iterate
+from .spmv import csr_spmv, ell_spmv, csr_to_ell
+from .transpose import transpose_pallas, transpose_xla
+from .elementwise import saxpy, parallel_sum
+from .segmented import segmented_scan_dense
 
 __all__ = [
     "STENCIL_COEFFS",
@@ -45,4 +49,12 @@ __all__ = [
     "csr_row_ids",
     "pagerank_propagate",
     "pagerank_iterate",
+    "csr_spmv",
+    "ell_spmv",
+    "csr_to_ell",
+    "transpose_pallas",
+    "transpose_xla",
+    "saxpy",
+    "parallel_sum",
+    "segmented_scan_dense",
 ]
