@@ -124,20 +124,36 @@ def _iterate(a, xx, flags, iters: int):
 
 
 def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
-                  dtype=jnp.float32) -> np.ndarray:
+                  dtype=jnp.float32, kernel: str = "flat") -> np.ndarray:
     """Device pipeline (fp.cu:154-190): upload, N × (multiply + segmented
     scan), download.  Prints the spec-mandated timing line
-    (Final.pdf §4.2 format, fp.cu:190)."""
+    (Final.pdf §4.2 format, fp.cu:190).
+
+    ``kernel``: "flat" = XLA log-sweep scan; "pallas" = single-HBM-pass
+    blockwise kernel with the multiply fused (``ops/segmented_pallas.py``).
+    """
+    import jax
+
     prob.validate()
     a = jnp.asarray(prob.a, dtype)
     xx = jnp.asarray(prob.xx, dtype)
     flags = head_flags_from_starts(jnp.asarray(prob.s[:-1]), prob.n)
     timer = timer or PhaseTimer()
+    if kernel == "pallas":
+        from ..ops.segmented_pallas import spmv_scan_pallas
+
+        interpret = jax.devices()[0].platform != "tpu"
+        runner = lambda v: spmv_scan_pallas(v, xx, flags, prob.iters,
+                                            interpret=interpret)
+    elif kernel == "flat":
+        runner = lambda v: _iterate(v, xx, flags, prob.iters)
+    else:
+        raise ValueError(f"unknown kernel {kernel!r}")
     # warmup compile outside the timed region (the CUDA analog timed only
     # kernel execution between cudaEvents)
-    _iterate(jnp.zeros_like(a), xx, flags, prob.iters).block_until_ready()
+    runner(jnp.zeros_like(a)).block_until_ready()
     with timer.phase("spmv_scan") as ph:
-        out = _iterate(a, xx, flags, prob.iters)
+        out = runner(a)
         ph.block(out)
     ms = timer.last_ms("spmv_scan")
     print(f"The running time of my code for {prob.iters} iterations is: "

@@ -52,7 +52,8 @@ def main(argv=None) -> int:
              threads=(1, 2) if q else (1, 2, 4, 8, 16, 32))),
         ("spmv_suite.csv",
          lambda: sweeps.spmv_suite_sweep(
-             scale=0.002 if q else 1.0)),
+             scale=0.002 if q else 1.0,
+             kernels=("flat",) if q else ("flat", "pallas"))),
     ]
     for fname, job in jobs:
         path = os.path.join(args.out, fname)

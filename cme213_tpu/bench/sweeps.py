@@ -258,7 +258,8 @@ def scan_sweep(n: int = 1 << 26, num_segments: int = 1 << 16) -> list[dict]:
     return rows
 
 
-def spmv_suite_sweep(names=None, scale: float = 0.05) -> list[dict]:
+def spmv_suite_sweep(names=None, scale: float = 0.05,
+                     kernels=("flat",)) -> list[dict]:
     from ..apps import spmv_scan as sp
     from ..core import PhaseTimer
 
@@ -266,12 +267,14 @@ def spmv_suite_sweep(names=None, scale: float = 0.05) -> list[dict]:
     rows = []
     for name in names:
         prob = sp.suite_problem(name, scale=scale)
-        timer = PhaseTimer()
-        out = sp.run_spmv_scan(prob, timer=timer)
-        errs = sp.external_check(prob, out)
-        rows.append({
-            "matrix": name, "n": prob.n, "p": prob.p, "iters": prob.iters,
-            "ms": round(timer.last_ms("spmv_scan"), 3),
-            "rel_l2": f"{errs['rel_l2']:.2e}",
-        })
+        for kernel in kernels:
+            timer = PhaseTimer()
+            out = sp.run_spmv_scan(prob, timer=timer, kernel=kernel)
+            errs = sp.external_check(prob, out)
+            rows.append({
+                "matrix": name, "kernel": kernel, "n": prob.n, "p": prob.p,
+                "iters": prob.iters,
+                "ms": round(timer.last_ms("spmv_scan"), 3),
+                "rel_l2": f"{errs['rel_l2']:.2e}",
+            })
     return rows

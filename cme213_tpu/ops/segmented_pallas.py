@@ -1,0 +1,170 @@
+"""Pallas blockwise segmented scan — single-pass O(n) kernel.
+
+The TPU hand-tuned counterpart of the reference's intra-warp segmented scan
+(``hw/hw_final/programming/fp.cu:28-59``).  The flat XLA formulation
+(``ops/segmented.py``) sweeps the whole array log2(n) times; this kernel does
+ONE pass over HBM using the hierarchical structure the reference's report
+derives (warp window → block → grid; ``paper`` §design, and the radix
+up/down-sweep, SURVEY §2.7 P7/P8):
+
+- each grid step processes an (R, 128) VMEM tile in row-major element order:
+  1. 7-step Hillis-Steele segmented scan along the 128-lane axis (the lane
+     version of the warp scan, with the head-flag operator),
+  2. log2(R)-step segmented scan of row summaries along the sublane axis,
+     broadcast back to the rows,
+  3. a scalar running carry — persisted in scratch across the sequentially-
+     executed grid steps — is added to elements before the tile's first
+     head, then updated to the scanned value of the tile's last element.
+
+The cross-tile carry is correct without a flag because the local scan
+already resets at heads: the last element's scanned value IS the running
+sum of the open segment.
+
+Exactness: identical additions in identical order as the flat version is
+NOT guaranteed (different association), so float results agree to rounding,
+not ULP — matching the reference's tolerance model for accumulating
+pipelines (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+
+
+def _make_kernel(rows: int, fused_multiply: bool = False):
+    def kernel(*refs):
+        if fused_multiply:
+            v_ref, xx_ref, f_ref, out_ref, carry = refs
+        else:
+            v_ref, f_ref, out_ref, carry = refs
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            carry[0, 0] = 0.0
+
+        v = v_ref[:]
+        if fused_multiply:
+            # the hw_final per-iteration elementwise multiply (fp.cu:176)
+            # fused into the scan's load, saving a full HBM round trip
+            v = v * xx_ref[:]
+        f = f_ref[:]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 1)
+        # 1) segmented scan along lanes
+        d = 1
+        while d < _LANES:
+            pv = jnp.roll(v, d, axis=1)
+            pf = jnp.roll(f, d, axis=1)
+            valid = lane >= d
+            v = v + jnp.where(valid & (f == 0), pv, jnp.zeros_like(v))
+            f = jnp.where(valid, f | pf, f)
+            d *= 2
+        # 2) segmented scan of row summaries along sublanes
+        row_v = v[:, _LANES - 1:]          # (R, 1) last-lane values
+        row_f = f[:, _LANES - 1:]          # (R, 1) any-head-in-row
+        rr = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
+        sv, sf = row_v, row_f
+        d = 1
+        while d < rows:
+            pv = jnp.roll(sv, d, axis=0)
+            pf = jnp.roll(sf, d, axis=0)
+            valid = rr >= d
+            sv = sv + jnp.where(valid & (sf == 0), pv, jnp.zeros_like(sv))
+            sf = jnp.where(valid, sf | pf, sf)
+            d *= 2
+        # exclusive: row r's incoming = inclusive through row r-1
+        inc_v = jnp.where(rr >= 1, jnp.roll(sv, 1, axis=0), jnp.zeros_like(sv))
+        inc_f = jnp.where(rr >= 1, jnp.roll(sf, 1, axis=0),
+                          jnp.zeros_like(sf))
+        v = v + jnp.where(f == 0, inc_v, jnp.zeros_like(v))
+        # 3) cross-tile carry for elements before the tile's first head
+        no_head_yet = (inc_f | f) == 0
+        v = v + jnp.where(no_head_yet, carry[0, 0], jnp.zeros_like(v))
+        carry[0, 0] = v[rows - 1, _LANES - 1]
+        out_ref[:] = v
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("rows", "interpret"))
+def segmented_scan_pallas(values: jnp.ndarray, head_flags: jnp.ndarray,
+                          rows: int = 32,
+                          interpret: bool = False) -> jnp.ndarray:
+    """Inclusive segmented sum scan of a 1-D f32 array, single HBM pass.
+
+    Pads to a (rows × 128) tile multiple internally (padding isolated in its
+    own segment and dropped on return).
+    """
+    assert values.dtype == jnp.float32
+    n = values.shape[0]
+    block = rows * _LANES
+    nblk = max(1, -(-n // block))
+    padded = nblk * block
+    v = jnp.zeros((padded,), jnp.float32).at[:n].set(values)
+    f = jnp.zeros((padded,), jnp.int32).at[:n].set(
+        head_flags.astype(jnp.int32))
+    if padded > n:
+        f = f.at[n].set(1)  # quarantine the pad
+    v2 = v.reshape(nblk * rows, _LANES)
+    f2 = f.reshape(nblk * rows, _LANES)
+    out = pl.pallas_call(
+        _make_kernel(rows),
+        out_shape=jax.ShapeDtypeStruct((nblk * rows, _LANES), jnp.float32),
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((1, 1), jnp.float32)],
+        interpret=interpret,
+    )(v2, f2)
+    return out.reshape(padded)[:n]
+
+
+@partial(jax.jit, static_argnames=("iters", "rows", "interpret"),
+         donate_argnums=(0,))
+def spmv_scan_pallas(a: jnp.ndarray, xx: jnp.ndarray,
+                     head_flags: jnp.ndarray, iters: int, rows: int = 32,
+                     interpret: bool = False) -> jnp.ndarray:
+    """The full hw_final iteration with the multiply fused into the scan:
+    N × one-HBM-pass ``a ← segscan(a·xx)``.  Pads once outside the loop."""
+    assert a.dtype == jnp.float32
+    n = a.shape[0]
+    block = rows * _LANES
+    nblk = max(1, -(-n // block))
+    padded = nblk * block
+    shape2 = (nblk * rows, _LANES)
+    v2 = jnp.zeros((padded,), jnp.float32).at[:n].set(a).reshape(shape2)
+    # pad xx with 1s so pad values stay 0 (0·1) without affecting real data
+    xx2 = jnp.ones((padded,), jnp.float32).at[:n].set(xx).reshape(shape2)
+    f = jnp.zeros((padded,), jnp.int32).at[:n].set(
+        head_flags.astype(jnp.int32))
+    if padded > n:
+        f = f.at[n].set(1)
+    f2 = f.reshape(shape2)
+
+    spec = pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    step = pl.pallas_call(
+        _make_kernel(rows, fused_multiply=True),
+        out_shape=jax.ShapeDtypeStruct(shape2, jnp.float32),
+        grid=(nblk,),
+        in_specs=[spec, spec, spec],
+        out_specs=spec,
+        scratch_shapes=[pltpu.VMEM((1, 1), jnp.float32)],
+        interpret=interpret,
+    )
+
+    out = jax.lax.fori_loop(0, iters, lambda _, v: step(v, xx2, f2), v2)
+    return out.reshape(padded)[:n]

@@ -1,0 +1,75 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cme213_tpu.ops.segmented import head_flags_from_starts
+from cme213_tpu.ops.segmented_pallas import segmented_scan_pallas
+from cme213_tpu.verify import golden
+
+INTERPRET = jax.devices()[0].platform != "tpu"
+
+
+def _case(rng, n, p):
+    starts = np.sort(rng.choice(np.arange(1, n), size=p - 1, replace=False))
+    s = np.concatenate([[0], starts]).astype(np.int32)
+    v = rng.standard_normal(n).astype(np.float32)
+    return v, s
+
+
+@pytest.mark.parametrize("n,p,rows", [
+    (128 * 8, 10, 8),        # exactly one tile
+    (128 * 8 * 3, 50, 8),    # multiple tiles
+    (5000, 37, 8),           # padding required
+    (128 * 64, 200, 64),     # bigger tile rows
+])
+def test_matches_golden(n, p, rows):
+    rng = np.random.default_rng(n + p)
+    v, s = _case(rng, n, p)
+    flags = head_flags_from_starts(jnp.asarray(s), n)
+    out = np.asarray(segmented_scan_pallas(jnp.asarray(v), flags, rows=rows,
+                                           interpret=INTERPRET))
+    ref = golden.host_segmented_scan(v, s)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_single_long_segment_crosses_tiles():
+    n = 128 * 8 * 4
+    v = np.ones(n, dtype=np.float32)
+    flags = head_flags_from_starts(jnp.asarray([0], dtype=jnp.int32), n)
+    out = np.asarray(segmented_scan_pallas(jnp.asarray(v), flags, rows=8,
+                                           interpret=INTERPRET))
+    np.testing.assert_allclose(out, np.arange(1, n + 1, dtype=np.float32),
+                               rtol=1e-5)
+
+
+def test_heads_at_tile_boundaries():
+    rows = 8
+    block = rows * 128
+    n = block * 3
+    v = np.ones(n, dtype=np.float32)
+    s = np.array([0, block, 2 * block + 1], dtype=np.int32)
+    flags = head_flags_from_starts(jnp.asarray(s), n)
+    out = np.asarray(segmented_scan_pallas(jnp.asarray(v), flags, rows=rows,
+                                           interpret=INTERPRET))
+    ref = golden.host_segmented_scan(v, s)
+    np.testing.assert_allclose(out, ref)
+
+
+def test_spmv_scan_pallas_engine():
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(3000, 80, 64, iters=5, seed=21)
+    out = sp.run_spmv_scan(prob, kernel="pallas")
+    ref = golden.host_spmv_scan(prob.a, prob.s[:-1], prob.xx, prob.iters)
+    np.testing.assert_allclose(out, ref, atol=1e-2)
+
+
+def test_every_element_own_segment():
+    n = 1000
+    rng = np.random.default_rng(0)
+    v = rng.standard_normal(n).astype(np.float32)
+    flags = jnp.ones(n, jnp.int32)
+    out = np.asarray(segmented_scan_pallas(jnp.asarray(v), flags,
+                                           interpret=INTERPRET))
+    np.testing.assert_allclose(out, v, rtol=1e-6)
