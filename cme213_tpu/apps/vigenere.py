@@ -219,18 +219,24 @@ def print_digraph_table(text: jnp.ndarray) -> None:
         print(f"{chr(_A + c // 26)}{chr(_A + c % 26)}:  {cnt / total}")
 
 
-def main_create(argv):
+def key_string(shifts) -> str:
+    """Printable key: shift s → letter chr(s mod 26 + 'a') (shift 26 ≡ 0
+    prints 'a'); used identically by both CLIs so round-trips agree."""
+    return "".join(chr((int(s) % 26) + _A) for s in shifts)
+
+
+def main_create(argv, out_path: str = "cipher_text.txt"):
     """CLI of create_cipher.cu:77-99: ``input.txt period`` → writes
     ``cipher_text.txt``."""
     path, period = argv[1], int(argv[2])
     raw = np.fromfile(path, dtype=np.uint8)
     clean, shifts, cipher = create_cipher(raw, period)
-    print("Key:", "".join(chr(_A + (s - 1) % 26 + 1 - 1) for s in shifts))
-    cipher.tofile("cipher_text.txt")
+    print("Key:", key_string(shifts))
+    cipher.tofile(out_path)
     return 0
 
 
-def main_solve(argv):
+def main_solve(argv, out_path: str = "plain_text.txt"):
     """CLI of solve_cipher.cu:103-274: ``cipher_text.txt`` → stats tables,
     key, and ``plain_text.txt``."""
     cipher = np.fromfile(argv[1], dtype=np.uint8)
@@ -239,9 +245,8 @@ def main_solve(argv):
     print_digraph_table(dev)
     result = crack(cipher)
     print(f"\nkeyLength: {result.key_length}")
-    key = "".join(chr(_A + (int(s) - 1) % 26) for s in ((result.shifts - 1) % 26 + 1))
-    print("\nKey:", key, "\n")
-    result.plain_text.tofile("plain_text.txt")
+    print("\nKey:", key_string(result.shifts), "\n")
+    result.plain_text.tofile(out_path)
     return 0
 
 

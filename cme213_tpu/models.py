@@ -1,0 +1,10 @@
+"""Workload-family index (alias module).
+
+The framework's "model families" are the six reference workloads; their
+canonical homes are the driver modules in ``cme213_tpu.apps``.  This module
+re-exports them under one roof for discoverability.
+"""
+
+from .apps import cipher, heat2d, pagerank, sorts, spmv_scan, vigenere
+
+__all__ = ["cipher", "heat2d", "pagerank", "sorts", "spmv_scan", "vigenere"]

@@ -1,0 +1,9 @@
+"""Parallelism/distribution layer (alias module).
+
+Canonical home: ``cme213_tpu.dist`` (meshes, halo exchange, distributed heat
+steps, multi-device segmented scan, multi-host init).
+"""
+
+from .dist import *  # noqa: F401,F403
+from .dist import multihost  # noqa: F401
+from .dist import __all__  # noqa: F401

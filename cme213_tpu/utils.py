@@ -1,0 +1,9 @@
+"""Utility layer (alias module).
+
+Canonical home: ``cme213_tpu.core`` (timers, ULP comparison, error barriers,
+checkpointing, tracing).
+"""
+
+from .core import *  # noqa: F401,F403
+from .core import checkpoint, trace  # noqa: F401
+from .core import __all__  # noqa: F401
