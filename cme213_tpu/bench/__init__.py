@@ -1,7 +1,9 @@
 from .sweeps import (
     cipher_vector_length_sweep,
+    dist_heat_sweep,
     pagerank_avg_edges_sweep,
     heat_sweep,
+    scan_sweep,
     pallas_tile_sweep,
     sort_thread_sweep,
     spmv_suite_sweep,
@@ -11,6 +13,8 @@ from .sweeps import (
 
 __all__ = [
     "cipher_vector_length_sweep",
+    "dist_heat_sweep",
+    "scan_sweep",
     "pagerank_avg_edges_sweep",
     "heat_sweep",
     "pallas_tile_sweep",

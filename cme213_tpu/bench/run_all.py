@@ -46,6 +46,11 @@ def main(argv=None) -> int:
          lambda: sweeps.scan_sweep(
              n=1 << 16 if q else 1 << 26,
              num_segments=1 << 8 if q else 1 << 16)),
+        ("dist_heat_scaling.csv",
+         lambda: sweeps.dist_heat_sweep(
+             size=32 if q else 2000, order=2 if q else 8,
+             iters=3 if q else 100,
+             ndevs=(1, 2) if q else (1, 2, 4, 8))),
         ("sort_threads.csv",
          lambda: sweeps.sort_thread_sweep(
              num_elements=20_000 if q else 16_000_000,

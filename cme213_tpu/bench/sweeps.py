@@ -221,6 +221,38 @@ def sort_thread_sweep(num_elements: int = 1_000_000,
     return rows
 
 
+def dist_heat_sweep(size: int = 256, order: int = 8, iters: int = 20,
+                    ndevs=(1, 2, 4, 8)) -> list[dict]:
+    """Strong-scaling table for the distributed heat solver: device count ×
+    {1D stripes, 2D blocks} × {sync, overlapped} — the hw5 measurement grid
+    (``hw/hw5/programming/data.ods``; BASELINE.md hw5 table)."""
+    import jax
+
+    from ..config import GridMethod, SimParams
+    from ..dist import mesh_for_method, run_distributed_heat
+
+    rows = []
+    avail = len(jax.devices())
+    for nd in ndevs:
+        if nd > avail:
+            continue
+        for method in (GridMethod.STRIPES_1D, GridMethod.BLOCKS_2D):
+            for overlap in (False, True):
+                p = SimParams(nx=size, ny=size, order=order, iters=iters)
+                mesh = mesh_for_method(method, nd)
+                run_distributed_heat(p, mesh, iters=1, overlap=overlap)
+                t0 = time.perf_counter()
+                run_distributed_heat(p, mesh, overlap=overlap)
+                secs = time.perf_counter() - t0
+                rows.append({
+                    "devices": nd,
+                    "method": "1D" if method == GridMethod.STRIPES_1D else "2D",
+                    "scheme": "async" if overlap else "sync",
+                    "seconds": round(secs, 4),
+                })
+    return rows
+
+
 def scan_sweep(n: int = 1 << 26, num_segments: int = 1 << 16) -> list[dict]:
     """Effective bandwidth of the scan family at 2^26 floats: plain
     inclusive scan, segmented scan, and the tiled transpose (the

@@ -64,9 +64,31 @@ def _assemble_padded(block, params: SimParams, y_size: int, x_size: int):
     return xpad.T
 
 
+def _reimpose_ghost(new_block, params: SimParams, y_size: int, x_size: int):
+    """Reset ghost rows/columns (padding beyond the true ny×nx domain, used
+    to support grid sizes that don't divide the mesh — the analog of the
+    reference's remainder-on-last-rank layout, ``2dHeat.cpp:284-307``) to
+    the top/right BC values.  Held at BC each step, the first ``b`` ghost
+    lines act as the Dirichlet band for the true domain edge."""
+    ny_loc, nx_loc = new_block.shape
+    dtype = new_block.dtype
+    if y_size * ny_loc != params.ny:
+        gr = (lax.axis_index("y") * ny_loc
+              + jax.lax.broadcasted_iota(jnp.int32, new_block.shape, 0))
+        new_block = jnp.where(gr >= params.ny,
+                              jnp.asarray(params.bc_top, dtype), new_block)
+    if x_size * nx_loc != params.nx:
+        gc = ((lax.axis_index("x") if x_size > 1 else 0) * nx_loc
+              + jax.lax.broadcasted_iota(jnp.int32, new_block.shape, 1))
+        new_block = jnp.where(gc >= params.nx,
+                              jnp.asarray(params.bc_right, dtype), new_block)
+    return new_block
+
+
 def _sync_local_step(block, params: SimParams, y_size: int, x_size: int):
     padded = _assemble_padded(block, params, y_size, x_size)
-    return stencil_interior(padded, params.order, params.xcfl, params.ycfl)
+    new = stencil_interior(padded, params.order, params.xcfl, params.ycfl)
+    return _reimpose_ghost(new, params, y_size, x_size)
 
 
 def _overlap_local_step(block, params: SimParams, y_size: int, x_size: int):
@@ -88,7 +110,8 @@ def _overlap_local_step(block, params: SimParams, y_size: int, x_size: int):
     left = st(padded[b:b + ny, 0:3 * b])               # cols [0, b), mid rows
     right = st(padded[b:b + ny, nx - b:nx + 2 * b])
     middle = jnp.concatenate([left, inner, right], axis=1)
-    return jnp.concatenate([bottom, middle, top], axis=0)
+    new = jnp.concatenate([bottom, middle, top], axis=0)
+    return _reimpose_ghost(new, params, y_size, x_size)
 
 
 def distributed_heat_step(params: SimParams, mesh: Mesh, overlap: bool = False):
@@ -138,17 +161,40 @@ def run_distributed_heat(params: SimParams, mesh: Mesh,
     iters = params.iters if iters is None else iters
     overlap = (not params.synchronous) if overlap is None else overlap
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    if params.ny % axes.get("y", 1):
-        raise ValueError("ny must divide evenly over the y mesh axis")
-    if params.nx % axes.get("x", 1):
-        raise ValueError("nx must divide evenly over the x mesh axis")
+    y_size = axes.get("y", 1)
+    x_size = axes.get("x", 1)
+    b = params.border_size
+    # non-divisible grids: pad with ghost rows/cols held at the top/right BC
+    # each step (the reference's remainder-rank layout, 2dHeat.cpp:284-307,
+    # expressed as padding)
+    ny_pad = -(-params.ny // y_size) * y_size
+    nx_pad = -(-params.nx // x_size) * x_size
+    ny_loc = ny_pad // y_size
+    nx_loc = nx_pad // x_size
+    if ny_loc < b or nx_loc < b:
+        # a halo slab would span more than one neighbor shard — same
+        # local-extent constraint the reference's per-rank layout implies
+        raise ValueError(
+            f"local block ({ny_loc}×{nx_loc}) thinner than the stencil "
+            f"border ({b}); use fewer devices or a larger grid")
+    if overlap and (ny_loc < 2 * b or nx_loc < 2 * b):
+        # local blocks too thin for the interior/band split — the overlap
+        # decomposition needs ≥ 2·border rows/cols per shard
+        overlap = False
 
     full0 = make_initial_grid(params, dtype=dtype)
-    u0 = jnp.array(interior(full0, params.border_size))
+    u0 = np.array(interior(full0, b))
+    if ny_pad > params.ny:
+        pad_rows = np.full((ny_pad - params.ny, u0.shape[1]), params.bc_top,
+                           u0.dtype)
+        u0 = np.concatenate([u0, pad_rows], axis=0)
+    if nx_pad > params.nx:
+        pad_cols = np.full((u0.shape[0], nx_pad - params.nx), params.bc_right,
+                           u0.dtype)
+        u0 = np.concatenate([u0, pad_cols], axis=1)
     spec = P("y", "x" if "x" in axes else None)
-    u0 = jax.device_put(u0, NamedSharding(mesh, spec))
+    u0 = jax.device_put(jnp.asarray(u0), NamedSharding(mesh, spec))
     out = _run(u0, params, mesh, iters, overlap)
     final = np.array(make_initial_grid(params, dtype=dtype))
-    b = params.border_size
-    final[b:-b, b:-b] = np.asarray(out)
+    final[b:-b, b:-b] = np.asarray(out)[:params.ny, :params.nx]
     return final

@@ -95,6 +95,15 @@ def test_pallas_tile_sweep():
     assert [r["tile_y"] for r in rows] == [8, 16]
 
 
+def test_dist_heat_sweep():
+    from cme213_tpu.bench import dist_heat_sweep
+
+    rows = dist_heat_sweep(size=16, order=2, iters=2, ndevs=(1, 2))
+    # 2 devices × 2 methods × 2 schemes
+    assert len(rows) == 8
+    assert {r["scheme"] for r in rows} == {"sync", "async"}
+
+
 def test_heat_checkpoint_resume_integration(tmp_path):
     """Interrupt-and-resume equals an uninterrupted solve."""
     import jax.numpy as jnp

@@ -76,9 +76,41 @@ def test_mesh_for_method():
     assert m3.devices.shape == (2, 2)
 
 
-def test_uneven_shard_rejected():
-    params = SimParams(nx=24, ny=30, order=2, iters=2)
-    mesh = make_mesh_1d(4)
+@pytest.mark.parametrize("overlap", [False, True])
+def test_uneven_shards_match_single_device(overlap):
+    """Grid sizes that don't divide the mesh (the reference's remainder-rank
+    case) via ghost padding."""
+    params = SimParams(nx=24, ny=30, order=2, iters=6)
+    mesh = make_mesh_1d(4)  # 30 rows over 4 shards
+    ref = single_device_reference(params, 6)
+    out = run_distributed_heat(params, mesh, overlap=overlap)
+    res = check_ulp(ref, out, max_ulps=2, label="dist-uneven")
+    assert res, res.message
+
+
+def test_uneven_2d_shards():
+    params = SimParams(nx=21, ny=30, order=4, iters=5)
+    mesh = make_mesh_2d(2, 2)
+    ref = single_device_reference(params, 5)
+    out = run_distributed_heat(params, mesh, overlap=True)
+    res = check_ulp(ref, out, max_ulps=2, label="dist-uneven-2d")
+    assert res, res.message
+
+
+def test_thin_shards_fall_back_to_sync():
+    # ny_loc = 4: ≥ border(4) but < 2·border(8) — overlap decomposition
+    # infeasible, must auto-fall back to sync and stay correct
+    params = SimParams(nx=24, ny=32, order=8, iters=3)
+    mesh = make_mesh_1d(8)
+    ref = single_device_reference(params, 3)
+    out = run_distributed_heat(params, mesh, overlap=True)
+    res = check_ulp(ref, out, max_ulps=2, label="dist-thin")
+    assert res, res.message
+
+
+def test_too_thin_shards_rejected():
+    params = SimParams(nx=24, ny=16, order=8, iters=3)  # ny_loc=2 < border=4
+    mesh = make_mesh_1d(8)
     with pytest.raises(ValueError):
         run_distributed_heat(params, mesh)
 
