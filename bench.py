@@ -29,6 +29,7 @@ def measure() -> None:
 
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from cme213_tpu.config import SimParams
     from cme213_tpu.grid import make_initial_grid
@@ -40,7 +41,10 @@ def measure() -> None:
     iters_timed = 200
 
     params = SimParams(nx=nx, ny=ny, order=order, iters=1000)
-    u0 = make_initial_grid(params, dtype=jnp.float32)
+    # Host copy: the heat loops donate their input buffer, and device_put of
+    # an already-committed device array is a no-op returning the same buffer
+    # — which the first donated call would delete out from under us.
+    u0 = np.asarray(make_initial_grid(params, dtype=jnp.float32))
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     print(f"device: {dev}", file=sys.stderr)
@@ -48,14 +52,14 @@ def measure() -> None:
     candidates = {
         "xla": lambda u, it: run_heat(u, it, order, params.xcfl, params.ycfl),
         "pallas": lambda u, it: run_heat_pallas(
-            u, it, order, params.xcfl, params.ycfl, tile_y=250,
+            u, it, order, params.xcfl, params.ycfl, tile_y=200,
             interpret=not on_tpu),
         "pallas-k4": lambda u, it: run_heat_multistep(
             u, it, order, params.xcfl, params.ycfl, params.bc, k=4,
-            tile_y=200, interpret=not on_tpu),
+            tile_y=160, interpret=not on_tpu),
         "pallas-k8": lambda u, it: run_heat_multistep(
             u, it, order, params.xcfl, params.ycfl, params.bc, k=8,
-            tile_y=200, interpret=not on_tpu),
+            tile_y=80, interpret=not on_tpu),
     }
     if not on_tpu:  # interpret-mode pallas at 4000² would take forever
         candidates = {"xla": candidates["xla"]}
@@ -65,7 +69,9 @@ def measure() -> None:
     best_name, best_gbs = None, 0.0
     for name, fn in candidates.items():
         try:
-            jax.block_until_ready(fn(jax.device_put(u0, dev), 8))  # compile
+            # warmup with the SAME iters: 'iters' is a static jit arg, so a
+            # different count would leave compilation inside the timed bracket
+            jax.block_until_ready(fn(jax.device_put(u0, dev), iters_timed))
             u = jax.device_put(u0, dev)
             start = time.perf_counter()
             jax.block_until_ready(fn(u, iters_timed))

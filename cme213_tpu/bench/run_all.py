@@ -38,7 +38,7 @@ def main(argv=None) -> int:
          lambda: sweeps.pallas_tile_sweep(
              size=32 if q else 2000, order=2 if q else 8,
              iters=2 if q else 100,
-             tiles=(8, 16) if q else (40, 100, 200, 250, 500))),
+             tiles=(8, 16) if q else (40, 80, 200, 400))),
         ("transfer_bandwidth.csv",
          lambda: sweeps.transfer_bandwidth_sweep(
              sizes=(1 << 16,) if q else (1 << 20, 1 << 24, 1 << 27))),

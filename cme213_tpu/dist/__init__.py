@@ -1,5 +1,6 @@
 from .mesh import make_mesh_1d, make_mesh_2d, mesh_for_method
-from .heat import distributed_heat_step, run_distributed_heat
+from .heat import (distributed_heat_step, prepare_distributed_heat,
+                   run_distributed_heat)
 from .scan import distributed_segmented_scan
 
 __all__ = [
@@ -7,6 +8,7 @@ __all__ = [
     "make_mesh_2d",
     "mesh_for_method",
     "distributed_heat_step",
+    "prepare_distributed_heat",
     "run_distributed_heat",
     "distributed_segmented_scan",
 ]

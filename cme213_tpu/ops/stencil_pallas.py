@@ -4,15 +4,30 @@ TPU-native analog of the reference's shared-memory stencil kernel
 (``gpuShared``, ``hw/hw2/programming/2dHeat.cu:466-515``): where 128×4 CUDA
 threads cooperatively staged a 128×32 halo tile into ``__shared__`` and each
 thread emitted multiple rows, here each Pallas grid step DMAs a
-``(tile_y + 2·border, gx)`` row band from HBM into a VMEM scratch buffer
-(the explicit analog of the cooperative staging), then computes a
-``(tile_y, nx)`` output tile with the same shifted-slice expression as the
-XLA path (`ops/stencil.py`) — so results are bitwise comparable.
+``(tile_y + halo, gx)`` row band from HBM into a VMEM scratch buffer
+(the explicit analog of the cooperative staging) and computes a full-width
+output tile.
 
-The pure-XLA path usually reaches the HBM roofline on TPU because XLA fuses
-the whole stencil into one pass; this kernel exists as (a) the explicit
-VMEM-tiling parity artifact for strategy P3, and (b) a base to hand-tune
-(e.g. fusing the iteration loop or double-buffering the band DMA).
+Mosaic (TPU) lowering constraints shape the design:
+
+- HBM→VMEM copies need the lane (last) dimension to be 128-aligned, so the
+  callers pad the grid's x-extent to a multiple of 128 and the kernels work
+  full-width; the padding columns are dead weight the valid-interior masks
+  ignore.
+- Sub-array slices carry (sublane, lane) offset layouts that many Mosaic
+  ops refuse to combine, so the stencil's ±border shifts are expressed as
+  ``pltpu.roll`` (circular lane/sublane rotations) of the whole band, with
+  the wrapped edges masked off / discarded — the roll-and-mask formulation
+  of the same shifted-slice sum as the XLA path (`ops/stencil.py`), and the
+  results are bitwise comparable.
+
+``run_heat_multistep`` additionally fuses k timesteps per HBM pass
+(temporal blocking): each band carries k·border extra halo rows and applies
+the stencil k times on-chip, re-imposing the Dirichlet bands between
+sub-steps; the validity margin shrinks by ``border`` rows per sub-step,
+exactly covering the extra halo.  HBM traffic per k steps ≈ one read + one
+write of the grid vs k of each — the optimization the 48 KB shared
+memories of the reference's era couldn't hold enough halo for.
 """
 
 from __future__ import annotations
@@ -27,11 +42,25 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .stencil import BORDER_FOR_ORDER, STENCIL_COEFFS
 
+LANE = 128
 
-def _make_kernel(order: int, tile_y: int, gx: int, xcfl: float, ycfl: float):
+
+def _pad_lanes(gx: int) -> int:
+    return -(-gx // LANE) * LANE
+
+
+def _roll(u, shift: int, axis: int, interpret: bool):
+    if shift == 0:
+        return u
+    if interpret:  # pltpu.roll has no interpret-mode rule; jnp.roll matches
+        return jnp.roll(u, shift, axis)
+    return pltpu.roll(u, shift % u.shape[axis], axis)
+
+
+def _make_kernel(order: int, tile_y: int, xcfl: float, ycfl: float,
+                 interpret: bool):
     b = BORDER_FOR_ORDER[order]
     coeffs = STENCIL_COEFFS[order]
-    nx = gx - 2 * b
 
     def kernel(u_hbm, out_ref, band, sem):
         i = pl.program_id(0)
@@ -42,17 +71,41 @@ def _make_kernel(order: int, tile_y: int, gx: int, xcfl: float, ycfl: float):
         dma.wait()
         u = band[:]
         dtype = u.dtype
-        center = u[b:b + tile_y, b:b + nx]
-        accx = jnp.zeros_like(center)
-        accy = jnp.zeros_like(center)
+        accx = jnp.zeros_like(u)
+        accy = jnp.zeros_like(u)
         for k, c in enumerate(coeffs):
             c = jnp.asarray(c, dtype)
-            accx = accx + c * u[b:b + tile_y, k:k + nx]
-            accy = accy + c * u[k:k + tile_y, b:b + nx]
-        out_ref[:] = (center + jnp.asarray(xcfl, dtype) * accx
-                      + jnp.asarray(ycfl, dtype) * accy)
+            accx = accx + c * _roll(u, b - k, 1, interpret)
+            accy = accy + c * _roll(u, b - k, 0, interpret)
+        new = (u + jnp.asarray(xcfl, dtype) * accx
+               + jnp.asarray(ycfl, dtype) * accy)
+        # output rows are band rows [b, b+tile_y): rotate up, take the top
+        out_ref[:] = _roll(new, -b, 0, interpret)[:tile_y, :]
 
     return kernel
+
+
+def _stencil_full(up: jnp.ndarray, order: int, xcfl: float, ycfl: float,
+                  tile_y: int, interpret: bool) -> jnp.ndarray:
+    """(ny, gxp) full-width new interior from lane-padded halo grid."""
+    b = BORDER_FOR_ORDER[order]
+    gy, gxp = up.shape
+    ny = gy - 2 * b
+    assert gxp % LANE == 0 and ny % tile_y == 0
+    kernel = _make_kernel(order, tile_y, float(xcfl), float(ycfl), interpret)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((ny, gxp), up.dtype),
+        grid=(ny // tile_y,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((tile_y, gxp), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((tile_y + 2 * b, gxp), up.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(up)
 
 
 @partial(jax.jit,
@@ -62,32 +115,32 @@ def stencil_interior_pallas(u: jnp.ndarray, order: int, xcfl: float,
                             interpret: bool = False) -> jnp.ndarray:
     """New interior (ny, nx) from halo grid (gy, gx), VMEM-tiled.
 
-    ``ny`` must divide by ``tile_y`` (drivers pick a divisor; see
-    ``pick_tile``).  ``xcfl``/``ycfl`` must be concrete floats (they are
-    baked into the kernel as constants).
+    ``ny`` must divide by ``tile_y``, ideally a multiple of 8 (drivers pick
+    a divisor; see ``pick_tile``).  ``xcfl``/``ycfl`` must be concrete
+    floats (they are baked into the kernel as constants).
     """
     b = BORDER_FOR_ORDER[order]
     gy, gx = u.shape
     ny, nx = gy - 2 * b, gx - 2 * b
-    assert ny % tile_y == 0, "ny must divide by tile_y"
-    kernel = _make_kernel(order, tile_y, gx, float(xcfl), float(ycfl))
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((ny, nx), u.dtype),
-        grid=(ny // tile_y,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec((tile_y, nx), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((tile_y + 2 * b, gx), u.dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
-        interpret=interpret,
-    )(u)
+    gxp = _pad_lanes(gx)
+    up = jnp.pad(u, ((0, 0), (0, gxp - gx))) if gxp != gx else u
+    out = _stencil_full(up, order, xcfl, ycfl, tile_y, interpret)
+    return out[:, b:b + nx]
 
 
 def pick_tile(ny: int, target: int = 256) -> int:
-    """Largest divisor of ny not exceeding ``target``."""
+    """Largest divisor of ny not exceeding ``target``.
+
+    Prefers multiples of 8 (the f32 sublane quantum: Mosaic wants
+    8-aligned sublane extents), falling back to any divisor only when ny
+    has no 8-aligned one.
+    """
+    t = min(target, ny)
+    t -= t % 8
+    while t >= 8 and ny % t:
+        t -= 8
+    if t >= 8:
+        return t
     t = min(target, ny)
     while ny % t:
         t -= 1
@@ -96,38 +149,13 @@ def pick_tile(ny: int, target: int = 256) -> int:
 
 def _make_multistep_kernel(order: int, k: int, tile_y: int, gy: int, gx: int,
                            bc: tuple[float, float, float, float],
-                           xcfl: float, ycfl: float):
-    """k fused timesteps per HBM pass (temporal blocking).
-
-    Each grid step loads a ``(tile_y + 2·k·b, gx)`` band into VMEM and
-    applies the stencil k times entirely on-chip, re-imposing the Dirichlet
-    BC bands between sub-steps (masked writes keyed on global row/column
-    indices, in the reference's band order: bottom/top rows then left/right
-    columns overwrite corners).  The validity margin shrinks by ``b`` rows
-    per sub-step, exactly covering the extra halo — the central ``tile_y``
-    rows are exact after k steps.  HBM traffic per k steps ≈ one read + one
-    write of the grid, vs k of each for the one-step-per-pass kernels: the
-    optimization the 48 KB shared memories of the reference's era couldn't
-    hold enough halo for.
-    """
+                           xcfl: float, ycfl: float, interpret: bool):
+    """k fused timesteps per HBM pass (temporal blocking)."""
     b = BORDER_FOR_ORDER[order]
     K = k * b
     coeffs = STENCIL_COEFFS[order]
-    nx = gx - 2 * b
     H = tile_y + 2 * K
     bc_top, bc_left, bc_bottom, bc_right = bc
-
-    def substep(u):
-        dtype = u.dtype
-        center = u[b:H - b, b:b + nx]
-        accx = jnp.zeros_like(center)
-        accy = jnp.zeros_like(center)
-        for kk, c in enumerate(coeffs):
-            c = jnp.asarray(c, dtype)
-            accx = accx + c * u[b:H - b, kk:kk + nx]
-            accy = accy + c * u[kk:kk + H - 2 * b, b:b + nx]
-        return (center + jnp.asarray(xcfl, dtype) * accx
-                + jnp.asarray(ycfl, dtype) * accy)
 
     def kernel(u_hbm, out_ref, band, sem):
         i = pl.program_id(0)
@@ -135,23 +163,35 @@ def _make_multistep_kernel(order: int, k: int, tile_y: int, gy: int, gx: int,
             u_hbm.at[pl.ds(i * tile_y, H), :], band, sem)
         dma.start()
         dma.wait()
+        gxp = band.shape[1]
         # global halo-grid row of band-local row l: hr = i*tile_y + l - (K-b)
         hr0 = i * tile_y - (K - b)
-        rows = jax.lax.broadcasted_iota(jnp.int32, (H, gx), 0) + hr0
-        cols = jax.lax.broadcasted_iota(jnp.int32, (H, gx), 1)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (H, gxp), 0) + hr0
+        cols = jax.lax.broadcasted_iota(jnp.int32, (H, gxp), 1)
 
         u = band[:]
+        dtype = u.dtype
         for _ in range(k):
-            new = u.at[b:H - b, b:b + nx].set(substep(u))
-            # re-impose Dirichlet bands (order: bottom/top, then left/right)
-            new = jnp.where(rows < b, jnp.asarray(bc_bottom, u.dtype), new)
-            new = jnp.where(rows >= gy - b,
-                            jnp.asarray(bc_top, u.dtype), new)
-            new = jnp.where(cols < b, jnp.asarray(bc_left, u.dtype), new)
+            accx = jnp.zeros_like(u)
+            accy = jnp.zeros_like(u)
+            for kk, c in enumerate(coeffs):
+                c = jnp.asarray(c, dtype)
+                accx = accx + c * _roll(u, b - kk, 1, interpret)
+                accy = accy + c * _roll(u, b - kk, 0, interpret)
+            new = (u + jnp.asarray(xcfl, dtype) * accx
+                   + jnp.asarray(ycfl, dtype) * accy)
+            # band-edge cells hold roll-wrap garbage, but any cell within
+            # s·b of the band edge is outside substep s's validity margin
+            # anyway — only the Dirichlet bands need re-imposing
+            # (bottom/top then left/right, the reference's band order)
+            new = jnp.where(rows < b, jnp.asarray(bc_bottom, dtype), new)
+            new = jnp.where(rows >= gy - b, jnp.asarray(bc_top, dtype), new)
+            new = jnp.where(cols < b, jnp.asarray(bc_left, dtype), new)
             new = jnp.where(cols >= gx - b,
-                            jnp.asarray(bc_right, u.dtype), new)
+                            jnp.asarray(bc_right, dtype), new)
             u = new
-        out_ref[:] = u[K:K + tile_y, b:b + nx]
+        # output rows are band rows [K, K+tile_y)
+        out_ref[:] = _roll(u, -K, 0, interpret)[:tile_y, :]
 
     return kernel
 
@@ -175,22 +215,23 @@ def run_heat_multistep(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
     ny, nx = gy - 2 * b, gx - 2 * b
     assert iters % k == 0, "iters must divide by k"
     assert ny % tile_y == 0, "ny must divide by tile_y"
+    gxp = _pad_lanes(gx)
+    bc_top, bc_left, bc_bottom, bc_right = bc
 
     kernel = _make_multistep_kernel(order, k, tile_y, gy, gx, bc,
-                                    float(xcfl), float(ycfl))
-    bc_top, bc_left, bc_bottom, bc_right = bc
+                                    float(xcfl), float(ycfl), interpret)
     pad = K - b
 
     def call(padded):
         return pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((ny, nx), u.dtype),
+            out_shape=jax.ShapeDtypeStruct((ny, gxp), u.dtype),
             grid=(ny // tile_y,),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec((tile_y, nx), lambda i: (i, 0),
+            out_specs=pl.BlockSpec((tile_y, gxp), lambda i: (i, 0),
                                    memory_space=pltpu.VMEM),
             scratch_shapes=[
-                pltpu.VMEM((tile_y + 2 * K, gx), u.dtype),
+                pltpu.VMEM((tile_y + 2 * K, gxp), u.dtype),
                 pltpu.SemaphoreType.DMA,
             ],
             interpret=interpret,
@@ -198,25 +239,30 @@ def run_heat_multistep(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
 
     # extend the halo grid with replicated BC rows so every tile's input
     # window is in-bounds with a static size (the replicas hold exactly the
-    # values an infinite Dirichlet border would)
-    padded = jnp.concatenate([
-        jnp.full((pad, gx), jnp.asarray(bc_bottom, u.dtype)),
-        u,
-        jnp.full((pad, gx), jnp.asarray(bc_top, u.dtype)),
-    ], axis=0) if pad else u
+    # values an infinite Dirichlet border would), and pad lanes to 128
+    padded = u
+    if gxp != gx:
+        padded = jnp.pad(padded, ((0, 0), (0, gxp - gx)),
+                         constant_values=bc_right)
     if pad:
+        padded = jnp.concatenate([
+            jnp.full((pad, gxp), jnp.asarray(bc_bottom, u.dtype)),
+            padded,
+            jnp.full((pad, gxp), jnp.asarray(bc_top, u.dtype)),
+        ], axis=0)
         # left/right bands must extend through the replica rows too
         padded = padded.at[:pad, :b].set(jnp.asarray(bc_left, u.dtype))
-        padded = padded.at[:pad, gx - b:].set(jnp.asarray(bc_right, u.dtype))
         padded = padded.at[-pad:, :b].set(jnp.asarray(bc_left, u.dtype))
+        padded = padded.at[:pad, gx - b:].set(jnp.asarray(bc_right, u.dtype))
         padded = padded.at[-pad:, gx - b:].set(jnp.asarray(bc_right, u.dtype))
 
     def body(_, p):
-        new_int = call(p)
-        return p.at[K:K + ny, b:b + nx].set(new_int)
+        # the kernel's BC masking keeps halo columns (and lane padding) at
+        # their Dirichlet values, so the full-width band writes back whole
+        return p.at[K:K + ny, :].set(call(p))
 
     padded = lax.fori_loop(0, iters // k, body, padded)
-    return padded[pad:pad + gy, :] if pad else padded
+    return padded[pad:pad + gy, :gx] if pad else padded[:, :gx]
 
 
 @partial(jax.jit,
@@ -227,10 +273,15 @@ def run_heat_pallas(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
                     tile_y: int = 256, interpret: bool = False) -> jnp.ndarray:
     """Iterated solve using the Pallas stencil (functional ping-pong)."""
     b = BORDER_FOR_ORDER[order]
+    gy, gx = u.shape
+    ny, nx = gy - 2 * b, gx - 2 * b
+    gxp = _pad_lanes(gx)
+    up = jnp.pad(u, ((0, 0), (0, gxp - gx))) if gxp != gx else u
 
-    def body(_, g):
-        new = stencil_interior_pallas(g, order, xcfl, ycfl, tile_y=tile_y,
-                                      interpret=interpret)
-        return g.at[b:-b, b:-b].set(new)
+    def body(_, p):
+        new = _stencil_full(p, order, xcfl, ycfl, tile_y, interpret)
+        # only columns [b, b+nx) of the full-width tile are valid
+        return p.at[b:b + ny, b:b + nx].set(new[:, b:b + nx])
 
-    return lax.fori_loop(0, iters, body, u)
+    up = lax.fori_loop(0, iters, body, up)
+    return up[:, :gx]

@@ -1,0 +1,18 @@
+import sys, time
+import jax, jax.numpy as jnp, numpy as np
+from cme213_tpu.config import SimParams
+from cme213_tpu.grid import make_initial_grid
+from cme213_tpu.ops.stencil_pallas import run_heat_pallas
+
+n = int(sys.argv[1]); t = int(sys.argv[2])
+p = SimParams(nx=n, ny=n, order=8, iters=1000)
+u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+t0 = time.perf_counter()
+jax.block_until_ready(run_heat_pallas(jax.device_put(u0), 1, p.order, p.xcfl, p.ycfl, tile_y=t))
+print(f"n={n} t={t} compile+1it: {time.perf_counter()-t0:.1f}s", flush=True)
+for it in (1, 8):
+    u = jax.device_put(u0)
+    t0 = time.perf_counter()
+    jax.block_until_ready(run_heat_pallas(u, it, p.order, p.xcfl, p.ycfl, tile_y=t))
+    dt = time.perf_counter() - t0
+    print(f"  iters={it}: {dt*1e3:.1f} ms total, {dt/it*1e3:.2f} ms/iter", flush=True)

@@ -1,0 +1,72 @@
+"""Iterate on the Pallas stencil kernels on the real TPU chip.
+
+Correctness at small size vs the XLA path, then timing at 4000^2 over
+tile/k choices.  Dev tool, not part of the package.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cme213_tpu.config import SimParams
+from cme213_tpu.grid import make_initial_grid
+from cme213_tpu.ops import run_heat
+from cme213_tpu.ops.stencil_pallas import run_heat_multistep, run_heat_pallas
+
+dev = jax.devices()[0]
+print("device:", dev)
+
+# ---- correctness, 256^2 order 8 ----
+p = SimParams(nx=256, ny=256, order=8, iters=8)
+u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+ref = np.asarray(run_heat(jnp.array(u0), 8, p.order, p.xcfl, p.ycfl))
+
+for name, fn in {
+    "pallas t=64": lambda u: run_heat_pallas(u, 8, p.order, p.xcfl, p.ycfl,
+                                             tile_y=64),
+    "k4 t=64": lambda u: run_heat_multistep(u, 8, p.order, p.xcfl, p.ycfl,
+                                            p.bc, k=4, tile_y=64),
+    "k8 t=64": lambda u: run_heat_multistep(u, 8, p.order, p.xcfl, p.ycfl,
+                                            p.bc, k=8, tile_y=64),
+}.items():
+    try:
+        out = np.asarray(fn(jnp.array(u0)))
+        err = np.abs(out - ref).max()
+        print(f"{name}: max|err| = {err:.3e}", "OK" if err < 1e-5 else "BAD")
+    except Exception as e:
+        print(f"{name}: FAILED {type(e).__name__}: {e}")
+
+if "--no-time" in sys.argv:
+    sys.exit(0)
+
+# ---- timing, 4000^2 order 8 ----
+p = SimParams(nx=4000, ny=4000, order=8, iters=1000)
+u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+iters = 200
+bytes_per_iter = 2 * 4 * 4000 * 4000
+
+cands = {"xla": lambda u, it: run_heat(u, it, p.order, p.xcfl, p.ycfl)}
+for t in (80, 160, 200, 400):
+    cands[f"pallas t={t}"] = (
+        lambda u, it, t=t: run_heat_pallas(u, it, p.order, p.xcfl, p.ycfl,
+                                           tile_y=t))
+for k in (2, 4, 8):
+    for t in (80, 160, 200):
+        cands[f"k{k} t={t}"] = (
+            lambda u, it, k=k, t=t: run_heat_multistep(
+                u, it, p.order, p.xcfl, p.ycfl, p.bc, k=k, tile_y=t))
+
+for name, fn in cands.items():
+    try:
+        jax.block_until_ready(fn(jax.device_put(u0), 8))
+        u = jax.device_put(u0)
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(u, iters))
+        dt = (time.perf_counter() - t0) / iters
+        print(f"{name}: {dt * 1e3:.3f} ms/iter, "
+              f"{bytes_per_iter / dt / 1e9:.1f} GB/s eff")
+    except Exception as e:
+        msg = str(e).split("\n")[0][:160]
+        print(f"{name}: FAILED {type(e).__name__}: {msg}")

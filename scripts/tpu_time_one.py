@@ -1,0 +1,27 @@
+"""Time one heat-kernel config at 4000^2 order 8 on the TPU: 
+usage: tpu_time_one.py {xla | pallas TILE | multi K TILE} [iters]"""
+import sys, time
+import jax, jax.numpy as jnp, numpy as np
+from cme213_tpu.config import SimParams
+from cme213_tpu.grid import make_initial_grid
+from cme213_tpu.ops import run_heat
+from cme213_tpu.ops.stencil_pallas import run_heat_multistep, run_heat_pallas
+
+p = SimParams(nx=4000, ny=4000, order=8, iters=1000)
+u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+mode = sys.argv[1]
+iters = int(sys.argv[-1]) if sys.argv[-1].isdigit() and len(sys.argv) > (3 if mode != "xla" else 2) + (1 if mode == "multi" else 0) else 200
+if mode == "xla":
+    fn = lambda u, it: run_heat(u, it, p.order, p.xcfl, p.ycfl)
+elif mode == "pallas":
+    t = int(sys.argv[2])
+    fn = lambda u, it: run_heat_pallas(u, it, p.order, p.xcfl, p.ycfl, tile_y=t)
+else:
+    k, t = int(sys.argv[2]), int(sys.argv[3])
+    fn = lambda u, it: run_heat_multistep(u, it, p.order, p.xcfl, p.ycfl, p.bc, k=k, tile_y=t)
+jax.block_until_ready(fn(jax.device_put(u0), 8))
+u = jax.device_put(u0)
+t0 = time.perf_counter()
+jax.block_until_ready(fn(u, iters))
+dt = (time.perf_counter() - t0) / iters
+print(f"{' '.join(sys.argv[1:])}: {dt*1e3:.3f} ms/iter, {2*4*4000*4000/dt/1e9:.1f} GB/s eff", flush=True)

@@ -70,7 +70,8 @@ def test_multistep_nonuniform_state():
 
 
 def test_pick_tile():
-    assert pick_tile(4000, 256) == 250
+    assert pick_tile(4000, 256) == 200  # 8-aligned divisor preferred
     assert pick_tile(256, 256) == 256
-    assert pick_tile(30, 16) == 15
+    assert pick_tile(4000, 450) == 400
+    assert pick_tile(30, 16) == 15      # no 8-aligned divisor: fall back
     assert pick_tile(7, 16) == 7
