@@ -42,7 +42,62 @@ def _load():
         _lib.parallel_sum_omp.argtypes = [f32p, ctypes.c_long]
         _lib.parallel_sum_omp.restype = ctypes.c_double
         _lib.saxpy_omp.argtypes = [ctypes.c_float, f32p, f32p, ctypes.c_long]
+        ll4 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        _lib.spmv_read_header.argtypes = [ctypes.c_char_p, ll4]
+        _lib.spmv_read_header.restype = ctypes.c_int
+        _lib.spmv_read_arrays.argtypes = [ctypes.c_char_p, f32p,
+                                          ctypes.c_longlong, i32p,
+                                          ctypes.c_longlong, i32p]
+        _lib.spmv_read_arrays.restype = ctypes.c_int
+        _lib.read_floats.argtypes = [ctypes.c_char_p, f32p,
+                                     ctypes.c_longlong]
+        _lib.read_floats.restype = ctypes.c_longlong
+        _lib.write_floats.argtypes = [ctypes.c_char_p, f32p,
+                                      ctypes.c_longlong]
+        _lib.write_floats.restype = ctypes.c_int
     return _lib
+
+
+def spmv_read(a_path: str):
+    """Parse the hw_final ``a.txt`` format natively.
+
+    Returns ``(a, s, k, q, iters)``.  Raises ``OSError`` / ``ValueError``
+    on unreadable or malformed files (the fail-fast behavior of the
+    reference's validating loader)."""
+    lib = _load()
+    hdr = np.zeros(4, np.int64)
+    rc = lib.spmv_read_header(a_path.encode(), hdr)
+    if rc:
+        raise OSError(f"cannot read header of {a_path} (code {rc})")
+    n, p, q, iters = (int(v) for v in hdr)
+    a = np.empty(n, np.float32)
+    s = np.empty(p, np.int32)
+    k = np.empty(n, np.int32)
+    rc = lib.spmv_read_arrays(a_path.encode(), a, n, s, p, k)
+    if rc:
+        raise ValueError(f"malformed {a_path} (section {rc})")
+    return a, s, k, q, iters
+
+
+def read_floats(path: str, count: int) -> np.ndarray:
+    """Read ``count`` whitespace-separated floats (x.txt / b.txt shape)."""
+    lib = _load()
+    out = np.empty(count, np.float32)
+    got = lib.read_floats(path.encode(), out, count)
+    if got < 0:
+        raise OSError(f"cannot read {path}")
+    if got < count:
+        raise ValueError(f"{path}: expected {count} floats, found {got}")
+    return out
+
+
+def write_floats(path: str, values: np.ndarray) -> None:
+    """Write one float per line (the b.txt output shape, fp.cu:192-199)."""
+    lib = _load()
+    values = np.ascontiguousarray(values, dtype=np.float32)
+    rc = lib.write_floats(path.encode(), values, values.size)
+    if rc:
+        raise OSError(f"cannot write {path} (code {rc})")
 
 
 def merge_sort(arr: np.ndarray, sort_threshold: int = 4096,

@@ -13,16 +13,17 @@ import subprocess
 from pathlib import Path
 
 _HERE = Path(__file__).resolve().parent
-_SRC = _HERE / "sorts.cpp"
+_SRCS = [_HERE / "sorts.cpp", _HERE / "io.cpp"]
 _LIB = _HERE / "_libsorts.so"
 
 
 def build_library(force: bool = False) -> Path:
-    if not force and _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+    newest = max(s.stat().st_mtime for s in _SRCS)
+    if not force and _LIB.exists() and _LIB.stat().st_mtime >= newest:
         return _LIB
     debug = os.environ.get("CME213_TPU_NATIVE_DEBUG") == "1"
     opt = ["-g", "-O0"] if debug else ["-O3"]
     cmd = ["g++", "-std=c++17", *opt, "-fopenmp", "-shared", "-fPIC",
-           str(_SRC), "-o", str(_LIB)]
+           *map(str, _SRCS), "-o", str(_LIB)]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
     return _LIB

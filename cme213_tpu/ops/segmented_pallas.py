@@ -38,7 +38,16 @@ from jax.experimental.pallas import tpu as pltpu
 _LANES = 128
 
 
-def _make_kernel(rows: int, fused_multiply: bool = False):
+def _roll(u, shift: int, axis: int, interpret: bool):
+    """Circular shift; pltpu.roll on hardware (sub-array slices carry
+    Mosaic offset layouts that concat — hence jnp.roll — can't combine)."""
+    if interpret:
+        return jnp.roll(u, shift, axis)
+    return pltpu.roll(u, shift % u.shape[axis], axis)
+
+
+def _make_kernel(rows: int, fused_multiply: bool = False,
+                 interpret: bool = False):
     def kernel(*refs):
         if fused_multiply:
             v_ref, xx_ref, f_ref, out_ref, carry = refs
@@ -57,37 +66,43 @@ def _make_kernel(rows: int, fused_multiply: bool = False):
             v = v * xx_ref[:]
         f = f_ref[:]
         lane = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 1)
+        rr = jax.lax.broadcasted_iota(jnp.int32, (rows, _LANES), 0)
         # 1) segmented scan along lanes
         d = 1
         while d < _LANES:
-            pv = jnp.roll(v, d, axis=1)
-            pf = jnp.roll(f, d, axis=1)
+            pv = _roll(v, d, 1, interpret)
+            pf = _roll(f, d, 1, interpret)
             valid = lane >= d
             v = v + jnp.where(valid & (f == 0), pv, jnp.zeros_like(v))
             f = jnp.where(valid, f | pf, f)
             d *= 2
-        # 2) segmented scan of row summaries along sublanes
-        row_v = v[:, _LANES - 1:]          # (R, 1) last-lane values
-        row_f = f[:, _LANES - 1:]          # (R, 1) any-head-in-row
-        rr = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
-        sv, sf = row_v, row_f
+        # 2) segmented scan of row summaries along sublanes, carried on
+        # full-width (rows, 128) arrays (each row = its summary broadcast
+        # across lanes) — lane-1 slices would carry offset layouts Mosaic
+        # sublane ops dislike; the redundant lanes are free on the VPU
+        sv = jnp.broadcast_to(v[:, _LANES - 1:], (rows, _LANES))
+        sf = jnp.broadcast_to(f[:, _LANES - 1:], (rows, _LANES))
         d = 1
         while d < rows:
-            pv = jnp.roll(sv, d, axis=0)
-            pf = jnp.roll(sf, d, axis=0)
+            pv = _roll(sv, d, 0, interpret)
+            pf = _roll(sf, d, 0, interpret)
             valid = rr >= d
             sv = sv + jnp.where(valid & (sf == 0), pv, jnp.zeros_like(sv))
             sf = jnp.where(valid, sf | pf, sf)
             d *= 2
         # exclusive: row r's incoming = inclusive through row r-1
-        inc_v = jnp.where(rr >= 1, jnp.roll(sv, 1, axis=0), jnp.zeros_like(sv))
-        inc_f = jnp.where(rr >= 1, jnp.roll(sf, 1, axis=0),
+        inc_v = jnp.where(rr >= 1, _roll(sv, 1, 0, interpret),
+                          jnp.zeros_like(sv))
+        inc_f = jnp.where(rr >= 1, _roll(sf, 1, 0, interpret),
                           jnp.zeros_like(sf))
         v = v + jnp.where(f == 0, inc_v, jnp.zeros_like(v))
         # 3) cross-tile carry for elements before the tile's first head
         no_head_yet = (inc_f | f) == 0
         v = v + jnp.where(no_head_yet, carry[0, 0], jnp.zeros_like(v))
-        carry[0, 0] = v[rows - 1, _LANES - 1]
+        # masked full-reduce scalar extract (vector.extract of a single
+        # element is not a Mosaic-friendly shape)
+        last = (rr == rows - 1) & (lane == _LANES - 1)
+        carry[0, 0] = jnp.sum(jnp.where(last, v, jnp.zeros_like(v)))
         out_ref[:] = v
 
     return kernel
@@ -115,7 +130,7 @@ def segmented_scan_pallas(values: jnp.ndarray, head_flags: jnp.ndarray,
     v2 = v.reshape(nblk * rows, _LANES)
     f2 = f.reshape(nblk * rows, _LANES)
     out = pl.pallas_call(
-        _make_kernel(rows),
+        _make_kernel(rows, interpret=interpret),
         out_shape=jax.ShapeDtypeStruct((nblk * rows, _LANES), jnp.float32),
         grid=(nblk,),
         in_specs=[
@@ -126,7 +141,7 @@ def segmented_scan_pallas(values: jnp.ndarray, head_flags: jnp.ndarray,
         ],
         out_specs=pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.VMEM((1, 1), jnp.float32)],
+        scratch_shapes=[pltpu.SMEM((1, 1), jnp.float32)],
         interpret=interpret,
     )(v2, f2)
     return out.reshape(padded)[:n]
@@ -157,12 +172,12 @@ def spmv_scan_pallas(a: jnp.ndarray, xx: jnp.ndarray,
     spec = pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
     step = pl.pallas_call(
-        _make_kernel(rows, fused_multiply=True),
+        _make_kernel(rows, fused_multiply=True, interpret=interpret),
         out_shape=jax.ShapeDtypeStruct(shape2, jnp.float32),
         grid=(nblk,),
         in_specs=[spec, spec, spec],
         out_specs=spec,
-        scratch_shapes=[pltpu.VMEM((1, 1), jnp.float32)],
+        scratch_shapes=[pltpu.SMEM((1, 1), jnp.float32)],
         interpret=interpret,
     )
 

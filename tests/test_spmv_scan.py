@@ -65,3 +65,45 @@ def test_suite_problem_scaled():
     prob.validate()
     out = sp.run_spmv_scan(prob)
     assert np.isfinite(out).all()
+
+
+def test_native_loader_matches_python(tmp_path):
+    prob = sp.generate_problem(2000, 40, 37, iters=7, seed=3)
+    a, x = str(tmp_path / "a.txt"), str(tmp_path / "x.txt")
+    sp.save_problem(prob, a, x)
+    py = sp.load_problem(a, x, use_native=False)
+    nat = sp.load_problem(a, x, use_native=True)
+    np.testing.assert_array_equal(py.a, nat.a)
+    np.testing.assert_array_equal(py.s, nat.s)
+    np.testing.assert_array_equal(py.k, nat.k)
+    np.testing.assert_array_equal(py.x, nat.x)
+    assert py.iters == nat.iters
+
+
+def test_native_write_read_floats(tmp_path):
+    from cme213_tpu import native
+
+    rng = np.random.default_rng(5)
+    vals = rng.standard_normal(777).astype(np.float32)
+    path = str(tmp_path / "b.txt")
+    native.write_floats(path, vals)
+    back = native.read_floats(path, 777)
+    np.testing.assert_array_equal(vals, back)  # %.9g round-trips f32
+    with pytest.raises(ValueError):
+        native.read_floats(path, 778)
+
+
+def test_cli_run_and_check(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    assert sp.main(["spmv_scan", "gen", "a.txt", "x.txt",
+                    "3000", "50", "49", "6"]) == 0
+    assert sp.main(["spmv_scan", "a.txt", "x.txt", "cpu_check"]) == 0
+    cap = capsys.readouterr().out
+    assert "The running time of my code for 6 iterations is:" in cap
+    assert "Worked! device and reference output match." in cap
+    b = np.loadtxt("b.txt", dtype=np.float32)
+    b_cpu = np.loadtxt("b_cpu.txt", dtype=np.float32)
+    assert b.shape == b_cpu.shape == (3000,)
+    scale = np.abs(b_cpu).max()
+    assert np.abs(b - b_cpu).max() <= 1e-3 * max(scale, 1.0)
+    assert sp.main(["spmv_scan", "nope.txt", "x.txt"]) == 2
