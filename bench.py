@@ -33,7 +33,7 @@ def measure() -> None:
 
     from cme213_tpu.config import SimParams
     from cme213_tpu.grid import make_initial_grid
-    from cme213_tpu.ops import run_heat
+    from cme213_tpu.ops import run_heat, run_heat_conv
     from cme213_tpu.ops.stencil_pallas import run_heat_multistep, run_heat_pallas
 
     nx = ny = 4000
@@ -51,6 +51,8 @@ def measure() -> None:
 
     candidates = {
         "xla": lambda u, it: run_heat(u, it, order, params.xcfl, params.ycfl),
+        "xla-conv": lambda u, it: run_heat_conv(
+            u, it, order, params.xcfl, params.ycfl),
         "pallas": lambda u, it: run_heat_pallas(
             u, it, order, params.xcfl, params.ycfl, tile_y=200,
             interpret=not on_tpu),

@@ -10,10 +10,16 @@ shard carries are combined with the segmented-scan operator across devices,
 and each shard applies its incoming carry to the elements before its first
 segment head.
 
-The carry combine is O(P) on gathered carries (``lax.all_gather`` over ICI;
-P = mesh axis size, so the unrolled prefix is tiny) — the mesh-scale
-equivalent of the serial bucket scan between the two parallel phases of the
-reference's radix pass.
+Two carry-combine backends:
+
+- ``ring`` (default): log2(P) ``lax.ppermute`` distance-d shifts running
+  the segmented-scan operator over the mesh axis itself — every hop is a
+  neighbor shift on the ICI ring, no gather; the same pattern ring
+  attention uses to pipeline KV blocks, applied to scan carries.
+- ``gather``: ``lax.all_gather`` of the P carries + an unrolled exclusive
+  prefix on each shard — the mesh-scale equivalent of the serial bucket
+  scan between the two parallel phases of the reference's radix pass
+  (fine for small P).
 """
 
 from __future__ import annotations
@@ -28,15 +34,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.segmented import segmented_scan
 
 
-def _local_with_carry(values, flags, axis_name: str, axis_size: int):
-    local = segmented_scan(values, flags)
-    # shard carry: (last partial sum, does my shard contain a head?)
-    carry_v = local[-1]
-    carry_f = jnp.max(flags).astype(jnp.int32)
+def _carry_gather(carry_v, carry_f, axis_name: str, axis_size: int):
+    """Exclusive segmented prefix of shard carries via all_gather +
+    unrolled combine (O(P) work replicated on every shard)."""
     vs = lax.all_gather(carry_v, axis_name)      # (P,)
     fs = lax.all_gather(carry_f, axis_name)      # (P,)
-    # exclusive prefix-combine of carries with the segmented operator,
-    # unrolled over the (small, static) mesh axis
     prefixes_v = [jnp.zeros_like(carry_v)]
     prefixes_f = [jnp.zeros_like(carry_f)]
     for j in range(axis_size - 1):
@@ -44,7 +46,41 @@ def _local_with_carry(values, flags, axis_name: str, axis_size: int):
         prefixes_v.append(vs[j] + jnp.where(fs[j] > 0, jnp.zeros_like(pv), pv))
         prefixes_f.append(pf | fs[j])
     idx = lax.axis_index(axis_name)
-    incoming = jnp.stack(prefixes_v)[idx]
+    return jnp.stack(prefixes_v)[idx]
+
+
+def _carry_ring(carry_v, carry_f, axis_name: str, axis_size: int):
+    """Exclusive segmented prefix of shard carries via log2(P) ppermute
+    shifts — the segmented Hillis-Steele sweep run over the device axis.
+
+    Distance-d hops are neighbor shifts on the ICI ring; shards with no
+    source at a given distance receive ppermute's zero fill, which is
+    exactly the scan identity (sum 0, no head seen)."""
+    inc_v, inc_f = carry_v, carry_f      # inclusive combine through shard i
+    idx = lax.axis_index(axis_name)
+    d = 1
+    while d < axis_size:
+        perm = [(i, i + d) for i in range(axis_size - d)]
+        pv = lax.ppermute(inc_v, axis_name, perm)
+        pf = lax.ppermute(inc_f, axis_name, perm)
+        valid = idx >= d
+        inc_v = inc_v + jnp.where(valid & (inc_f == 0), pv,
+                                  jnp.zeros_like(pv))
+        inc_f = jnp.where(valid, inc_f | pf, inc_f)
+        d *= 2
+    # exclusive = inclusive of the previous shard, shifted down the ring
+    perm1 = [(i, i + 1) for i in range(axis_size - 1)]
+    return lax.ppermute(inc_v, axis_name, perm1)
+
+
+def _local_with_carry(values, flags, axis_name: str, axis_size: int,
+                      carry_mode: str = "ring"):
+    local = segmented_scan(values, flags)
+    # shard carry: (last partial sum, does my shard contain a head?)
+    carry_v = local[-1]
+    carry_f = jnp.max(flags).astype(jnp.int32)
+    combine = _carry_ring if carry_mode == "ring" else _carry_gather
+    incoming = combine(carry_v, carry_f, axis_name, axis_size)
     # apply to elements of the incoming open segment: position i belongs to
     # it iff no head at any position <= i (cummax of flags still 0)
     no_head_yet = lax.cummax(flags, axis=0) == 0
@@ -52,23 +88,29 @@ def _local_with_carry(values, flags, axis_name: str, axis_size: int):
 
 
 def distributed_segmented_scan(values: jnp.ndarray, head_flags: jnp.ndarray,
-                               mesh: Mesh, axis_name: str | None = None):
+                               mesh: Mesh, axis_name: str | None = None,
+                               carry_mode: str = "ring"):
     """Segmented inclusive scan of a sequence sharded over one mesh axis.
 
     ``len(values)`` must divide evenly over the axis.  Works under jit; the
-    result carries the same sharding as the input.
+    result carries the same sharding as the input.  ``carry_mode``:
+    ``"ring"`` (log-P ppermute sweep) or ``"gather"`` (all_gather + local
+    prefix).
     """
     axis_name = axis_name or mesh.axis_names[0]
     axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
     if values.shape[0] % axis_size:
         raise ValueError("sequence length must divide over the mesh axis")
+    if carry_mode not in ("ring", "gather"):
+        raise ValueError(f"unknown carry_mode {carry_mode!r}")
     spec = P(axis_name)
     sharding = NamedSharding(mesh, spec)
     values = jax.device_put(values, sharding)
     head_flags = jax.device_put(head_flags.astype(jnp.int32), sharding)
 
     fn = jax.jit(jax.shard_map(
-        partial(_local_with_carry, axis_name=axis_name, axis_size=axis_size),
+        partial(_local_with_carry, axis_name=axis_name, axis_size=axis_size,
+                carry_mode=carry_mode),
         mesh=mesh, in_specs=(spec, spec), out_specs=spec,
     ))
     return fn(values, head_flags)

@@ -1,4 +1,6 @@
-from .stencil import STENCIL_COEFFS, BORDER_FOR_ORDER, stencil_interior, heat_step, run_heat
+from .stencil import (STENCIL_COEFFS, BORDER_FOR_ORDER, stencil_interior,
+                      stencil_interior_conv, heat_step, run_heat,
+                      run_heat_conv)
 from .elementwise import (
     shift_cipher,
     shift_cipher_packed,
@@ -27,6 +29,8 @@ __all__ = [
     "stencil_interior",
     "heat_step",
     "run_heat",
+    "run_heat_conv",
+    "stencil_interior_conv",
     "shift_cipher",
     "shift_cipher_packed",
     "vigenere_shift",

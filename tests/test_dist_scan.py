@@ -55,3 +55,27 @@ def test_uneven_length_rejected():
     f = jnp.zeros(100, jnp.int32).at[0].set(1)
     with pytest.raises(ValueError):
         distributed_segmented_scan(v, f, mesh)
+
+
+@pytest.mark.parametrize("mode", ["ring", "gather"])
+def test_carry_modes_agree(mode):
+    from cme213_tpu.ops import segmented_scan
+
+    mesh = make_mesh_1d(8)
+    rng = np.random.default_rng(11)
+    n = 8 * 64
+    v = rng.standard_normal(n).astype(np.float32)
+    starts = np.unique(np.concatenate([[0], rng.integers(1, n, 9)]))
+    flags = head_flags_from_starts(jnp.asarray(starts, jnp.int32), n)
+    ref = np.asarray(segmented_scan(jnp.asarray(v), flags))
+    out = np.asarray(distributed_segmented_scan(
+        jnp.asarray(v), flags, mesh, carry_mode=mode))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_carry_mode_rejects_unknown():
+    mesh = make_mesh_1d(8)
+    v = jnp.ones((16,), jnp.float32)
+    f = jnp.zeros((16,), jnp.int32).at[0].set(1)
+    with pytest.raises(ValueError):
+        distributed_segmented_scan(v, f, mesh, carry_mode="bogus")

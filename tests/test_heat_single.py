@@ -87,3 +87,17 @@ def test_save_grid_to_file(tmp_path):
     # top row printed first = bc_top in interior columns
     first = lines[0].split()
     assert float(first[1]) == p.bc_top
+
+
+def test_conv_stencil_matches_slices():
+    import jax.numpy as jnp
+    from cme213_tpu.config import SimParams
+    from cme213_tpu.grid import make_initial_grid
+    from cme213_tpu.ops import run_heat, run_heat_conv
+
+    for order in (2, 4, 8):
+        p = SimParams(nx=96, ny=64, order=order, iters=6)
+        u0 = make_initial_grid(p, dtype=jnp.float32)
+        a = np.asarray(run_heat(jnp.array(u0), 6, order, p.xcfl, p.ycfl))
+        b = np.asarray(run_heat_conv(jnp.array(u0), 6, order, p.xcfl, p.ycfl))
+        np.testing.assert_allclose(b, a, rtol=5e-6, atol=5e-6)
