@@ -83,7 +83,11 @@ def stencil_interior_conv(u: jnp.ndarray, order: int, xcfl,
     out = lax.conv_general_dilated(
         u[None, None], kern[None, None], window_strides=(1, 1),
         padding="VALID",
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        # full f32 accumulation: the TPU default decomposes f32 convs into
+        # bf16 MXU passes, which the 9..14350 coefficient spread would
+        # amplify to ~1e-3 relative error
+        precision=lax.Precision.HIGHEST)
     return out[0, 0]
 
 

@@ -57,19 +57,38 @@ def _roll(u, shift: int, axis: int, interpret: bool):
     return pltpu.roll(u, shift % u.shape[axis], axis)
 
 
+def _stage_band(u_hbm, bands, sems, tile_y: int, H: int):
+    """Double-buffered cooperative band staging, shared by both kernels:
+    start the DMA for band i+1, wait for band i, return it (scratch
+    persists across the sequentially-executed grid steps)."""
+    i = pl.program_id(0)
+    nblk = pl.num_programs(0)
+
+    def get_dma(slot, blk):
+        return pltpu.make_async_copy(
+            u_hbm.at[pl.ds(blk * tile_y, H), :], bands.at[slot],
+            sems.at[slot])
+
+    @pl.when(i == 0)
+    def _():
+        get_dma(0, 0).start()
+
+    @pl.when(i + 1 < nblk)
+    def _():
+        get_dma((i + 1) % 2, i + 1).start()
+
+    get_dma(i % 2, i).wait()
+    return bands[i % 2]
+
+
 def _make_kernel(order: int, tile_y: int, xcfl: float, ycfl: float,
                  interpret: bool):
     b = BORDER_FOR_ORDER[order]
     coeffs = STENCIL_COEFFS[order]
+    H = tile_y + 2 * b
 
-    def kernel(u_hbm, out_ref, band, sem):
-        i = pl.program_id(0)
-        # cooperative tile staging: DMA the row band (+halo) into VMEM
-        dma = pltpu.make_async_copy(
-            u_hbm.at[pl.ds(i * tile_y, tile_y + 2 * b), :], band, sem)
-        dma.start()
-        dma.wait()
-        u = band[:]
+    def kernel(u_hbm, out_ref, bands, sems):
+        u = _stage_band(u_hbm, bands, sems, tile_y, H)
         dtype = u.dtype
         accx = jnp.zeros_like(u)
         accy = jnp.zeros_like(u)
@@ -101,8 +120,8 @@ def _stencil_full(up: jnp.ndarray, order: int, xcfl: float, ycfl: float,
         out_specs=pl.BlockSpec((tile_y, gxp), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((tile_y + 2 * b, gxp), up.dtype),
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((2, tile_y + 2 * b, gxp), up.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,
     )(up)
@@ -157,19 +176,15 @@ def _make_multistep_kernel(order: int, k: int, tile_y: int, gy: int, gx: int,
     H = tile_y + 2 * K
     bc_top, bc_left, bc_bottom, bc_right = bc
 
-    def kernel(u_hbm, out_ref, band, sem):
+    def kernel(u_hbm, out_ref, bands, sems):
         i = pl.program_id(0)
-        dma = pltpu.make_async_copy(
-            u_hbm.at[pl.ds(i * tile_y, H), :], band, sem)
-        dma.start()
-        dma.wait()
-        gxp = band.shape[1]
+        u = _stage_band(u_hbm, bands, sems, tile_y, H)
+        gxp = bands.shape[2]
         # global halo-grid row of band-local row l: hr = i*tile_y + l - (K-b)
         hr0 = i * tile_y - (K - b)
         rows = jax.lax.broadcasted_iota(jnp.int32, (H, gxp), 0) + hr0
         cols = jax.lax.broadcasted_iota(jnp.int32, (H, gxp), 1)
 
-        u = band[:]
         dtype = u.dtype
         for _ in range(k):
             accx = jnp.zeros_like(u)
@@ -231,8 +246,8 @@ def run_heat_multistep(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
             out_specs=pl.BlockSpec((tile_y, gxp), lambda i: (i, 0),
                                    memory_space=pltpu.VMEM),
             scratch_shapes=[
-                pltpu.VMEM((tile_y + 2 * K, gxp), u.dtype),
-                pltpu.SemaphoreType.DMA,
+                pltpu.VMEM((2, tile_y + 2 * K, gxp), u.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
             ],
             interpret=interpret,
         )(padded)
