@@ -24,6 +24,29 @@ BASELINE_GBS = 23.97  # hw2 shared-memory order-8 4000² float (BASELINE.md)
 _CHILD_FLAG = "--run-measurement"
 
 
+_PREFLIGHT_EXIT = 42
+
+
+def _preflight(seconds: float = 90.0) -> bool:
+    """Run a trivial device op on a watchdog thread.  A wedged TPU tunnel
+    hangs inside PJRT client creation where Python signals can't fire, so
+    the check runs in a daemon thread and the caller exits if it never
+    returns."""
+    import threading
+
+    done = threading.Event()
+
+    def probe():
+        import jax
+        import jax.numpy as jnp
+
+        (jnp.ones((8, 8)) * 2).block_until_ready()
+        done.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    return done.wait(seconds)
+
+
 def measure() -> None:
     import time
 
@@ -100,8 +123,13 @@ def measure() -> None:
 
 def main() -> None:
     if _CHILD_FLAG in sys.argv:
+        if not _preflight():
+            print("preflight: device unreachable within 90s", file=sys.stderr)
+            sys.exit(_PREFLIGHT_EXIT)
         measure()
         return
+    import time as _time
+
     for attempt in range(3):
         try:
             proc = subprocess.run(
@@ -118,6 +146,8 @@ def main() -> None:
             return
         print(f"attempt {attempt + 1}: exit {proc.returncode}",
               file=sys.stderr)
+        if proc.returncode == _PREFLIGHT_EXIT and attempt < 2:
+            _time.sleep(120)  # wedged tunnel: give it a chance to recover
     print(json.dumps({
         "metric": "heat2d stencil order-8 4000x4000 f32 effective bandwidth "
                   "(DEVICE UNAVAILABLE)",
