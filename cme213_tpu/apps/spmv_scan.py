@@ -150,7 +150,9 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
     (Final.pdf §4.2 format, fp.cu:190).
 
     ``kernel``: "flat" = XLA log-sweep scan; "pallas" = single-HBM-pass
-    blockwise kernel with the multiply fused (``ops/segmented_pallas.py``).
+    blockwise kernel with the multiply fused (``ops/segmented_pallas.py``);
+    "dense" = the per-segment dense-matrix strawman (the role the
+    reference kept ``fp_old.cu`` around for — O(p·max_seg_len) work).
     """
     import jax
 
@@ -167,6 +169,20 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
                                             interpret=interpret)
     elif kernel == "flat":
         runner = lambda v: _iterate(v, xx, flags, prob.iters)
+    elif kernel == "dense":
+        from ..ops.segmented import segmented_scan_dense
+
+        starts = jnp.asarray(prob.s[:-1])
+        max_len = int(np.diff(prob.s).max())
+
+        @partial(jax.jit, static_argnames=("iters",), donate_argnums=(0,))
+        def _iterate_dense(v, xx, iters: int):
+            def body(_, v):
+                return segmented_scan_dense(v * xx, starts, max_len)
+
+            return jax.lax.fori_loop(0, iters, body, v)
+
+        runner = lambda v: _iterate_dense(v, xx, prob.iters)
     else:
         raise ValueError(f"unknown kernel {kernel!r}")
     # warmup compile outside the timed region (the CUDA analog timed only
@@ -290,7 +306,7 @@ def main(argv: list[str]) -> int:
     """Driver CLI mirroring the reference's fp binary (fp.cu:74-216) plus a
     readMM-style ``gen`` subcommand:
 
-        spmv_scan a.txt x.txt [cpu_check] [--kernel=flat|pallas]
+        spmv_scan a.txt x.txt [cpu_check] [--kernel=flat|pallas|dense]
         spmv_scan gen a.txt x.txt [n p q [iters]] [--seed=S]
 
     The run form loads the problem, executes the device pipeline (printing
@@ -310,8 +326,8 @@ def main(argv: list[str]) -> int:
         elif a.startswith("--"):
             print(f"error: unknown option {a!r} (flags use --name=value)")
             return 2
-    if kernel not in ("flat", "pallas"):
-        print(f"error: unknown kernel {kernel!r} (flat|pallas)")
+    if kernel not in ("flat", "pallas", "dense"):
+        print(f"error: unknown kernel {kernel!r} (flat|pallas|dense)")
         return 2
 
     if args and args[0] == "gen":

@@ -39,6 +39,10 @@ def main(argv=None) -> int:
              size=32 if q else 2000, order=2 if q else 8,
              iters=2 if q else 100,
              tiles=(8, 16) if q else (40, 80, 200, 400))),
+        ("heat_kernels.csv",
+         lambda: sweeps.heat_kernel_sweep(
+             size=64 if q else 4000, order=8, iters=8 if q else 64,
+             ks=(2, 4) if q else (2, 4, 8))),
         ("transfer_bandwidth.csv",
          lambda: sweeps.transfer_bandwidth_sweep(
              sizes=(1 << 16,) if q else (1 << 20, 1 << 24, 1 << 27))),

@@ -193,6 +193,61 @@ def pallas_tile_sweep(size: int = 2000, order: int = 8, iters: int = 50,
     return rows
 
 
+def heat_kernel_sweep(size: int = 4000, order: int = 8,
+                      iters: int = 64, ks=(2, 4, 8),
+                      tile: int | None = None) -> list[dict]:
+    """Kernel-strategy comparison for the headline stencil: XLA fused
+    slices vs one-op conv vs Pallas VMEM band kernel vs k-step temporal
+    blocking — the effective-bandwidth table behind bench.py's
+    best-kernel pick (reference analog: global vs shared-memory kernels
+    in ``data/data.ods``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import SimParams
+    from ..grid import make_initial_grid
+    from ..ops import run_heat, run_heat_conv
+    from ..ops.stencil_pallas import (pick_tile, run_heat_multistep,
+                                      run_heat_pallas)
+
+    interpret = jax.devices()[0].platform != "tpu"
+    p = SimParams(nx=size, ny=size, order=order, iters=iters)
+    u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+    t = tile or pick_tile(p.ny, 200)
+    nbytes = 2 * 4 * size * size * iters
+
+    cands = {
+        "xla": lambda u: run_heat(u, iters, order, p.xcfl, p.ycfl),
+        "xla-conv": lambda u: run_heat_conv(u, iters, order, p.xcfl,
+                                            p.ycfl),
+        "pallas": lambda u: run_heat_pallas(u, iters, order, p.xcfl,
+                                            p.ycfl, tile_y=t,
+                                            interpret=interpret),
+    }
+    for k in ks:
+        if iters % k == 0:
+            cands[f"pallas-k{k}"] = (
+                lambda u, k=k: run_heat_multistep(
+                    u, iters, order, p.xcfl, p.ycfl, p.bc, k=k, tile_y=t,
+                    interpret=interpret))
+
+    rows = []
+    for name, fn in cands.items():
+        try:
+            jax.block_until_ready(fn(jnp.array(u0)))  # same-iters warmup
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(jnp.array(u0)))
+            ms = (time.perf_counter() - t0) * 1e3
+        except Exception as e:  # a kernel variant failing to lower is data
+            rows.append({"kernel": name, "ms": -1.0, "gbs": 0.0,
+                         "error": type(e).__name__})
+            continue
+        rows.append({"kernel": name, "ms": round(ms, 2),
+                     "gbs": round(nbytes / 1e9 / (ms / 1e3), 2),
+                     "error": ""})
+    return rows
+
+
 def sort_thread_sweep(num_elements: int = 1_000_000,
                       threads=(1, 2, 4, 8, 16, 32)) -> list[dict]:
     from .. import native

@@ -107,3 +107,12 @@ def test_cli_run_and_check(tmp_path, monkeypatch, capsys):
     scale = np.abs(b_cpu).max()
     assert np.abs(b - b_cpu).max() <= 1e-3 * max(scale, 1.0)
     assert sp.main(["spmv_scan", "nope.txt", "x.txt"]) == 2
+
+
+def test_dense_kernel_matches_flat():
+    prob = sp.generate_problem(4000, 80, 79, iters=4, seed=9)
+    out_flat = sp.run_spmv_scan(prob, kernel="flat")
+    out_dense = sp.run_spmv_scan(prob, kernel="dense")
+    scale = max(1.0, float(np.abs(out_flat).max()))
+    np.testing.assert_allclose(out_dense, out_flat, rtol=1e-5,
+                               atol=1e-6 * scale)
