@@ -250,9 +250,31 @@ def main_solve(argv, out_path: str = "plain_text.txt"):
     return 0
 
 
+def main(argv) -> int:
+    """Dispatch: ``vigenere [create] input.txt period`` encodes (the
+    reference's create_cipher CLI shape), ``vigenere solve cipher.txt``
+    cracks (solve_cipher's).  The bare form without the ``create`` word
+    matches the reference binary exactly."""
+    args = argv[1:]
+    if args and args[0] in ("create", "solve"):
+        sub, args = args[0], args[1:]
+    else:
+        sub = "create"
+    if (sub == "create" and len(args) != 2) or (sub == "solve"
+                                                and len(args) != 1):
+        print("usage: vigenere [create] input.txt period\n"
+              "       vigenere solve cipher_text.txt")
+        return 2
+    try:
+        if sub == "solve":
+            return main_solve(["solve", *args])
+        return main_create(["create", *args])
+    except (OSError, ValueError) as e:
+        print(f"error: {e}")
+        return 2
+
+
 if __name__ == "__main__":
     import sys
 
-    if sys.argv[1] == "solve":
-        raise SystemExit(main_solve(sys.argv[1:]))
-    raise SystemExit(main_create(sys.argv))
+    raise SystemExit(main(sys.argv))
