@@ -307,6 +307,7 @@ def main(argv: list[str]) -> int:
     readMM-style ``gen`` subcommand:
 
         spmv_scan a.txt x.txt [cpu_check] [--kernel=flat|pallas|dense]
+                  [--distributed]
         spmv_scan gen a.txt x.txt [n p q [iters]] [--seed=S]
 
     The run form loads the problem, executes the device pipeline (printing
@@ -318,11 +319,14 @@ def main(argv: list[str]) -> int:
     args = [a for a in argv[1:] if not a.startswith("--")]
     kernel = "flat"
     seed = 0
+    distributed = False
     for a in argv[1:]:
         if a.startswith("--kernel="):
             kernel = a.split("=", 1)[1]
         elif a.startswith("--seed="):
             seed = int(a.split("=", 1)[1])
+        elif a == "--distributed":
+            distributed = True
         elif a.startswith("--"):
             print(f"error: unknown option {a!r} (flags use --name=value)")
             return 2
@@ -359,7 +363,18 @@ def main(argv: list[str]) -> int:
     except (OSError, ValueError, IndexError) as e:
         print(f"error: cannot load problem: {e}")
         return 2
-    out = run_spmv_scan(prob, kernel=kernel)
+    if distributed:
+        from ..dist import make_mesh_1d
+
+        ndev = len(jax.devices())
+        timer = PhaseTimer()
+        out = run_spmv_scan_distributed(prob, make_mesh_1d(ndev),
+                                        timer=timer)
+        ms = timer.last_ms("spmv_scan_distributed")
+        print(f"The running time of my code for {prob.iters} iterations "
+              f"is: {ms} milliseconds. ({ndev} devices)")
+    else:
+        out = run_spmv_scan(prob, kernel=kernel)
 
     def write_out(path: str, values: np.ndarray) -> None:
         try:

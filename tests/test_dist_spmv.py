@@ -31,3 +31,16 @@ def test_multihost_noop_and_info():
     initialize_multihost(num_processes=1)  # single-process no-op
     pid, count = process_info()
     assert pid == 0 and count == 1
+
+
+def test_cli_distributed(tmp_path, monkeypatch, capsys):
+    from cme213_tpu.apps import spmv_scan as sp
+
+    monkeypatch.chdir(tmp_path)
+    assert sp.main(["spmv_scan", "gen", "a.txt", "x.txt",
+                    "2048", "32", "31", "5"]) == 0
+    assert sp.main(["spmv_scan", "a.txt", "x.txt", "cpu_check",
+                    "--distributed"]) == 0
+    out = capsys.readouterr().out
+    assert "(8 devices)" in out
+    assert "Worked!" in out
