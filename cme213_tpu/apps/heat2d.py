@@ -85,6 +85,11 @@ def run_single(params: SimParams, check_cpu: bool = True,
     result.reports.append(
         _report(params, "pallas", timer.last_ms("gpu computation shared")))
 
+    if save_files and ref is not None:
+        # the reference's artifact set includes the golden dump
+        # (grid_final_cpu.txt, 2dHeat.cu:686-711)
+        save_grid_to_file(jnp.asarray(ref), f"{out_dir}/grid_final_cpu.txt")
+
     for label, out in [("global", out_xla), ("shared", out_pl)]:
         if ref is not None:
             res = check_ulp(ref, np.asarray(out), max_ulps=10,
