@@ -40,8 +40,11 @@ def make_corpus(length: int = 1 << 20, seed: int = 0) -> np.ndarray:
 
 
 def run_cipher(text: np.ndarray | None = None, shift: int = 17,
-               replicate: int = 16, timer: PhaseTimer | None = None) -> bool:
-    """Returns True iff all device variants byte-match the host golden."""
+               replicate: int = 16, timer: PhaseTimer | None = None,
+               out_path: str | None = None) -> bool:
+    """Returns True iff all device variants byte-match the host golden.
+    With ``out_path``, writes the enciphered bytes (un-replicated prefix) —
+    the ``mobydick_enciphered.txt`` artifact (cipher.cu:262-275)."""
     timer = timer or PhaseTimer(verbose=True)
     if text is None:
         text = make_corpus()
@@ -78,8 +81,35 @@ def run_cipher(text: np.ndarray | None = None, shift: int = 17,
             print(f"Output of TPU {name} version and host version didn't match!")
             print(res.message)
             ok = False
+    if ok and out_path is not None:
+        ref[:text.size].tofile(out_path)
     return ok
 
 
+def main(argv: list[str]) -> int:
+    """CLI of the reference driver (cipher.cu:127-160): ``[input.txt
+    [shift]]`` — loads the text (falling back to a synthetic corpus),
+    replicates x16, runs host golden + all device variants, and writes
+    ``<input>_enciphered.txt``."""
+    text, out_path = None, None
+    shift = 17
+    if len(argv) > 1:
+        try:
+            text = np.fromfile(argv[1], dtype=np.uint8)
+        except OSError as e:
+            print(f"error: {e}")
+            return 2
+        base = argv[1].rsplit(".", 1)[0]
+        out_path = f"{base}_enciphered.txt"
+    if len(argv) > 2:
+        shift = int(argv[2])
+    ok = run_cipher(text=text, shift=shift, out_path=out_path)
+    if out_path and ok:
+        print(f"wrote {out_path}")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
-    raise SystemExit(0 if run_cipher() else 1)
+    import sys
+
+    raise SystemExit(main(sys.argv))
