@@ -1,0 +1,20 @@
+#!/bin/bash
+# One-shot TPU validation + measurement once the device tunnel is up:
+#  1. compile/correctness smoke of every Pallas kernel (small shapes)
+#  2. kernel-strategy sweep at the headline size -> CSV
+#  3. headline bench line
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH=/root/.axon_site:$PWD
+echo "=== smoke ==="
+python scripts/tpu_smoke.py || exit 1
+echo "=== kernel sweep ==="
+python - <<'PY'
+from cme213_tpu.bench.sweeps import heat_kernel_sweep, write_csv
+rows = heat_kernel_sweep(size=4000, order=8, iters=64)
+for r in rows:
+    print(r)
+write_csv(rows, "bench_results/heat_kernels_tpu.csv")
+PY
+echo "=== bench ==="
+python bench.py

@@ -101,3 +101,12 @@ def test_device_trace(tmp_path):
         (jnp.ones(64) * 2).block_until_ready()
     # trace directory created with some content
     assert any(tmp_path.rglob("*"))
+
+
+def test_heat_kernel_sweep_quick():
+    from cme213_tpu.bench.sweeps import heat_kernel_sweep
+
+    rows = heat_kernel_sweep(size=32, order=2, iters=4, ks=(2, 4), tile=8)
+    names = [r["kernel"] for r in rows]
+    assert names == ["xla", "xla-conv", "pallas", "pallas-k2", "pallas-k4"]
+    assert all(r["error"] == "" and r["ms"] > 0 for r in rows)
