@@ -133,15 +133,33 @@ def index_of_coincidence(text: jnp.ndarray, lag: int) -> float:
     return matches / ((n - lag) / 26.0)
 
 
+@partial(jax.jit, static_argnames=("max_lag",))
+def ioc_profile(text: jnp.ndarray, max_lag: int = 256) -> jnp.ndarray:
+    """IOC for every lag in [1, max_lag) in ONE device call.
+
+    The reference's detector loop does one ``inner_product`` per lag
+    (solve_cipher.cu:187-208) — a host round trip each.  ``lax.map`` keeps
+    the sweep on device (sequential, so memory stays O(n)) and returns the
+    whole profile for host-side thresholding."""
+    lags = jnp.arange(1, max_lag, dtype=jnp.int32)
+    matches = jax.lax.map(lambda lag: _num_matches(text, lag), lags)
+    n = text.shape[0]
+    return matches.astype(jnp.float32) / ((n - lags).astype(jnp.float32)
+                                          / 26.0)
+
+
 def find_key_length(text: jnp.ndarray, threshold: float = 1.6,
                     max_lag: int = 256) -> int:
     """IOC autocorrelation detector (solve_cipher.cu:187-208): the first
     spike gives a candidate k; a spike at exactly 2k confirms it; any other
-    spike is an unusual pattern."""
+    spike is an unusual pattern.  Thresholding runs on the host over the
+    device-computed profile, preserving the reference's exact scan order."""
+    import numpy as np
+
+    profile = np.asarray(ioc_profile(text, max_lag=max_lag))
     key_length = 0
     for lag in range(1, max_lag):
-        ioc = index_of_coincidence(text, lag)
-        if ioc > threshold:
+        if profile[lag - 1] > threshold:
             if key_length == 0:
                 key_length = lag
             elif 2 * key_length == lag:
