@@ -116,3 +116,17 @@ def test_dense_kernel_matches_flat():
     scale = max(1.0, float(np.abs(out_flat).max()))
     np.testing.assert_allclose(out_dense, out_flat, rtol=1e-5,
                                atol=1e-6 * scale)
+
+
+def test_native_loader_malformed_falls_back_or_raises(tmp_path):
+    from cme213_tpu import native
+
+    bad = tmp_path / "bad.txt"
+    bad.write_text("3 2 2\n")  # truncated header (3 of 4 ints)
+    with pytest.raises((OSError, ValueError)):
+        native.spmv_read(str(bad))
+
+    short = tmp_path / "short.txt"
+    short.write_text("4 2 2 5\n1.0 2.0\n")  # promises 4 values, has 2
+    with pytest.raises(ValueError):
+        native.spmv_read(str(short))
