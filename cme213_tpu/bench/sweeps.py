@@ -292,20 +292,29 @@ def dist_heat_sweep(size: int = 256, order: int = 8, iters: int = 20,
         if nd > avail:
             continue
         for method in (GridMethod.STRIPES_1D, GridMethod.BLOCKS_2D):
-            for overlap in (False, True):
+            for requested, overlap, k in (("sync", False, 1),
+                                          ("async", True, 1),
+                                          ("ca-k4", False, 4)):
                 p = SimParams(nx=size, ny=size, order=order, iters=iters)
                 mesh = mesh_for_method(method, nd)
-                iterate, used_overlap = prepare_distributed_heat(
-                    p, mesh, overlap=overlap)
+                iterate, used_overlap, used_k = prepare_distributed_heat(
+                    p, mesh, overlap=overlap, steps_per_exchange=k)
                 iterate()          # warmup: same iters → same executable
                 secs, _ = iterate()  # device loop only (MPI_Wtime analog)
+                # record the scheme that actually ran: overlap and the
+                # communication-avoiding path fall back when shards are
+                # too thin (or iters doesn't divide)
+                if used_k > 1:
+                    scheme = f"ca-k{used_k}"
+                elif used_overlap:
+                    scheme = "async"
+                else:
+                    scheme = "sync"
                 rows.append({
                     "devices": nd,
                     "method": "1D" if method == GridMethod.STRIPES_1D else "2D",
-                    # record the scheme that actually ran: overlap falls
-                    # back to sync when shards are too thin for the split
-                    "scheme": "async" if used_overlap else "sync",
-                    "requested": "async" if overlap else "sync",
+                    "scheme": scheme,
+                    "requested": requested,
                     "seconds": round(secs, 4),
                 })
     return rows

@@ -56,9 +56,14 @@ def _pad_axis0(block, axis_name, axis_size, border, lo_fill, hi_fill):
     return jnp.concatenate([lo, block, hi], axis=0)
 
 
-def _assemble_padded(block, params: SimParams, y_size: int, x_size: int):
-    """Local block + y halos + x halos (BC fill at physical boundaries)."""
-    b = params.border_size
+def _assemble_padded(block, params: SimParams, y_size: int, x_size: int,
+                     border: int | None = None):
+    """Local block + y halos + x halos (BC fill at physical boundaries).
+
+    ``border`` defaults to the stencil border; the communication-avoiding
+    path passes K = k·border.  The y-then-x order encodes the corner-fill
+    invariant (see module header)."""
+    b = params.border_size if border is None else border
     ypad = _pad_axis0(block, "y", y_size, b, params.bc_bottom, params.bc_top)
     xpad = _pad_axis0(ypad.T, "x", x_size, b, params.bc_left, params.bc_right)
     return xpad.T
@@ -114,6 +119,47 @@ def _overlap_local_step(block, params: SimParams, y_size: int, x_size: int):
     return _reimpose_ghost(new, params, y_size, x_size)
 
 
+def _multistep_local_step(block, params: SimParams, y_size: int, x_size: int,
+                          k: int):
+    """k timesteps per halo exchange (communication-avoiding stencil).
+
+    Exchanges K = k·border-wide halos once, then applies the stencil k
+    times locally; the validity margin shrinks by ``border`` per sub-step,
+    exactly covering the extra halo — the mesh-scale form of the Pallas
+    temporal-blocking kernel (``ops/stencil_pallas.run_heat_multistep``),
+    cutting ppermute message count by k at the cost of k·border redundant
+    boundary rows of compute.  Physical-boundary and ghost cells are
+    re-imposed between sub-steps keyed on global coordinates, so results
+    are bitwise identical to the k=1 paths.
+    """
+    b = params.border_size
+    K = k * b
+    ny_loc, nx_loc = block.shape
+    # K-wide halo assembly; BC fill replicates the Dirichlet band values an
+    # infinite border would hold
+    p = _assemble_padded(block, params, y_size, x_size, border=K)
+    H, W = p.shape
+    # global halo-grid coords of padded local cell (l_r, l_c)
+    gy0 = lax.axis_index("y") * ny_loc + b - K
+    gx0 = (lax.axis_index("x") if x_size > 1 else 0) * nx_loc + b - K
+    gr = gy0 + jax.lax.broadcasted_iota(jnp.int32, (H, W), 0)
+    gc = gx0 + jax.lax.broadcasted_iota(jnp.int32, (H, W), 1)
+    dtype = block.dtype
+    for _ in range(k):
+        inner = stencil_interior(p, params.order, params.xcfl, params.ycfl)
+        p = p.at[b:-b, b:-b].set(inner)
+        # Dirichlet bands; ghost rows/cols beyond the true ny×nx domain
+        # merge into the top/right conditions (they are held at those BC
+        # values, acting as the domain-edge band — see _reimpose_ghost)
+        p = jnp.where(gr < b, jnp.asarray(params.bc_bottom, dtype), p)
+        p = jnp.where(gr >= b + params.ny,
+                      jnp.asarray(params.bc_top, dtype), p)
+        p = jnp.where(gc < b, jnp.asarray(params.bc_left, dtype), p)
+        p = jnp.where(gc >= b + params.nx,
+                      jnp.asarray(params.bc_right, dtype), p)
+    return p[K:K + ny_loc, K:K + nx_loc]
+
+
 def distributed_heat_step(params: SimParams, mesh: Mesh, overlap: bool = False):
     """Build the sharded single-step function ``u (ny,nx) -> u'`` (interior
     arrays, sharded over ``mesh``)."""
@@ -132,18 +178,24 @@ def distributed_heat_step(params: SimParams, mesh: Mesh, overlap: bool = False):
     return step, spec
 
 
-@partial(jax.jit, static_argnames=("params", "mesh", "iters", "overlap"),
+@partial(jax.jit, static_argnames=("params", "mesh", "iters", "overlap",
+                                   "steps_per_exchange"),
          donate_argnums=(0,))
-def _run(u, params, mesh, iters, overlap):
+def _run(u, params, mesh, iters, overlap, steps_per_exchange=1):
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
     y_size = axes.get("y", 1)
     x_size = axes.get("x", 1)
     spec = P("y", "x" if "x" in axes else None)
-    local = _overlap_local_step if overlap else _sync_local_step
+    k = steps_per_exchange
+    if k > 1:
+        local = partial(_multistep_local_step, k=k)
+    else:
+        local = _overlap_local_step if overlap else _sync_local_step
 
     def sharded_loop(blk):
         return lax.fori_loop(
-            0, iters, lambda _, g: local(g, params, y_size, x_size), blk)
+            0, iters // k, lambda _, g: local(g, params, y_size, x_size),
+            blk)
 
     return jax.shard_map(sharded_loop, mesh=mesh,
                          in_specs=(spec,), out_specs=spec)(u)
@@ -151,8 +203,16 @@ def _run(u, params, mesh, iters, overlap):
 
 def prepare_distributed_heat(params: SimParams, mesh: Mesh,
                              iters: int | None = None, dtype=jnp.float32,
-                             overlap: bool | None = None):
-    """Set up a distributed solve and return ``(iterate, overlap_used)``.
+                             overlap: bool | None = None,
+                             steps_per_exchange: int = 1):
+    """Set up a distributed solve and return ``(iterate, overlap_used,
+    steps_per_exchange_used)``.
+
+    ``steps_per_exchange`` > 1 selects the communication-avoiding path
+    (k local sub-steps per K=k·border halo exchange,
+    ``_multistep_local_step``); it falls back to 1 when shards are thinner
+    than K, when ``iters`` doesn't divide by k, or combined with
+    ``overlap`` (fewer exchanges subsume the overlap split).
 
     ``iterate()`` uploads a fresh initial grid, runs the full iteration
     loop on device, and returns ``(seconds, out)`` where ``seconds`` times
@@ -192,6 +252,11 @@ def prepare_distributed_heat(params: SimParams, mesh: Mesh,
         # decomposition needs ≥ 2·border rows/cols per shard
         overlap = False
 
+    k = steps_per_exchange
+    if k > 1 and (overlap or iters % k
+                  or ny_loc < k * b or nx_loc < k * b):
+        k = 1  # communication-avoiding path ineligible: fall back
+
     full0 = make_initial_grid(params, dtype=dtype)
     u0 = np.array(interior(full0, b))
     if ny_pad > params.ny:
@@ -210,24 +275,26 @@ def prepare_distributed_heat(params: SimParams, mesh: Mesh,
         u = jax.device_put(jnp.asarray(u0), sharding)
         jax.block_until_ready(u)
         t0 = _time.perf_counter()
-        out = _run(u, params, mesh, iters, overlap)
+        out = _run(u, params, mesh, iters, overlap, steps_per_exchange=k)
         jax.block_until_ready(out)
         return _time.perf_counter() - t0, out
 
-    return iterate, overlap
+    return iterate, overlap, k
 
 
 def run_distributed_heat(params: SimParams, mesh: Mesh,
                          iters: int | None = None, dtype=jnp.float32,
-                         overlap: bool | None = None) -> np.ndarray:
+                         overlap: bool | None = None,
+                         steps_per_exchange: int = 1) -> np.ndarray:
     """Full distributed solve.  Returns the final full halo grid (gy, gx)
     as numpy, for direct comparison with the single-device solver and the
     reference's per-rank ``grid{rank}_final.txt`` methodology (SURVEY §4.4).
 
     ``overlap`` defaults to ``not params.synchronous`` (hw5 ``sync`` flag).
     """
-    iterate, _ = prepare_distributed_heat(params, mesh, iters=iters,
-                                          dtype=dtype, overlap=overlap)
+    iterate, _, _ = prepare_distributed_heat(
+        params, mesh, iters=iters, dtype=dtype, overlap=overlap,
+        steps_per_exchange=steps_per_exchange)
     _, out = iterate()
     b = params.border_size
     final = np.array(make_initial_grid(params, dtype=dtype))

@@ -98,10 +98,11 @@ def test_pallas_tile_sweep():
 def test_dist_heat_sweep():
     from cme213_tpu.bench import dist_heat_sweep
 
-    rows = dist_heat_sweep(size=16, order=2, iters=2, ndevs=(1, 2))
-    # 2 devices × 2 methods × 2 schemes
-    assert len(rows) == 8
-    assert {r["scheme"] for r in rows} == {"sync", "async"}
+    rows = dist_heat_sweep(size=16, order=2, iters=4, ndevs=(1, 2))
+    # 2 devices × 2 methods × 3 schemes (sync, async, comm-avoiding)
+    assert len(rows) == 12
+    assert {r["requested"] for r in rows} == {"sync", "async", "ca-k4"}
+    assert {r["scheme"] for r in rows} == {"sync", "async", "ca-k4"}
 
 
 def test_heat_checkpoint_resume_integration(tmp_path):

@@ -123,3 +123,35 @@ def test_synchronous_param_selects_variant():
     out = run_distributed_heat(params, mesh)
     res = check_ulp(ref, out, max_ulps=2, label="dist-async-param")
     assert res, res.message
+
+
+@pytest.mark.parametrize("method,ndev", [(GridMethod.STRIPES_1D, 4),
+                                         (GridMethod.BLOCKS_2D, 4)])
+@pytest.mark.parametrize("k", [2, 4])
+def test_communication_avoiding_matches_k1(method, ndev, k):
+    """k sub-steps per K-wide exchange must be bitwise identical to the
+    exchange-every-step path (same stencil expression per cell)."""
+    from cme213_tpu.dist import prepare_distributed_heat
+
+    # ny=64 over 4 stripes → ny_loc=16 ≥ K=k·4 for k≤4: the requested k
+    # must actually be used (no silent fallback making the test vacuous)
+    p = SimParams(nx=64, ny=64, order=8, iters=8, grid_method=method)
+    mesh = mesh_for_method(method, ndev)
+    _, _, k_used = prepare_distributed_heat(p, mesh, overlap=False,
+                                            steps_per_exchange=k)
+    assert k_used == k
+    base = run_distributed_heat(p, mesh, overlap=False)
+    multi = run_distributed_heat(p, mesh, overlap=False,
+                                 steps_per_exchange=k)
+    np.testing.assert_array_equal(multi, base)
+
+
+def test_communication_avoiding_fallback_thin_shards():
+    # 8 stripes of 6 rows each, order 8 (b=4): K=8 > 6 → must fall back
+    # to k=1 and still be correct
+    p = SimParams(nx=48, ny=48, order=8, iters=4)
+    mesh = mesh_for_method(GridMethod.STRIPES_1D, 8)
+    from cme213_tpu.dist import prepare_distributed_heat
+
+    _, _, k_used = prepare_distributed_heat(p, mesh, steps_per_exchange=2)
+    assert k_used == 1
