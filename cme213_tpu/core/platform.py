@@ -1,0 +1,53 @@
+"""Force a virtual n-device CPU mesh — the shared platform-defense recipe.
+
+Distributed code is validated on a fake host mesh (the TPU analog of the
+reference's "compare N-rank vs 1-rank" methodology, hw5 handout §5.1), which
+requires two order-sensitive steps:
+
+1. ``--xla_force_host_platform_device_count=n`` must be in ``XLA_FLAGS``
+   *before* the CPU client is created (the flag is read at client init).
+2. The platform must be forced to CPU via ``jax.config`` *after* importing
+   jax, because this environment's sitecustomize re-forces its own platform
+   list at interpreter startup — the ``JAX_PLATFORMS`` env var alone is
+   overridden.
+
+Used by both ``tests/conftest.py`` and ``__graft_entry__.dryrun_multichip``
+so the incantation can't drift between them.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_devices(n_devices: int) -> None:
+    """Pin JAX to the CPU platform with at least ``n_devices`` host devices.
+
+    Safe to call more than once; an existing smaller device-count flag is
+    raised to ``n_devices``.  Fails loudly if the CPU client was already
+    created with too few devices (the flag can no longer take effect).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{_FLAG}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = f"{flags} {_FLAG}={n_devices}".strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"{_FLAG}={n_devices}")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    devs = jax.devices()
+    if devs[0].platform != "cpu" or len(devs) < n_devices:
+        raise RuntimeError(
+            f"expected >= {n_devices} CPU devices, have {len(devs)} "
+            f"{devs[0].platform!r} device(s) — the XLA backend was "
+            "initialized before force_cpu_devices() could take effect "
+            "(jax.config platform updates are no-ops once a client "
+            "exists); call it before any other jax device use in the "
+            "process.")

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Probe the TPU tunnel until it answers; record status + timestamp.
+# Writes /tmp/tpu_status: "UP <epoch>" once a trivial device op completes,
+# otherwise keeps appending DOWN probes to /tmp/tpu_probe.log.
+# Used while the tunnel is wedged so bench capture can start the moment it
+# recovers (round-1 failure mode: BENCH_r01 = 0.0, device unreachable).
+INTERVAL="${1:-120}"
+DEADLINE="${2:-14400}"   # give up after 4h by default
+start=$(date +%s)
+while true; do
+  now=$(date +%s)
+  if [ $((now - start)) -gt "$DEADLINE" ]; then
+    echo "GAVE_UP $now" > /tmp/tpu_status
+    exit 1
+  fi
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform != 'cpu', 'silent CPU fallback'
+(jnp.ones((8,8))*2).block_until_ready()
+" >/dev/null 2>&1; then
+    echo "UP $(date +%s)" > /tmp/tpu_status
+    echo "$(date -Is) UP" >> /tmp/tpu_probe.log
+    exit 0
+  fi
+  echo "$(date -Is) DOWN" >> /tmp/tpu_probe.log
+  echo "DOWN $(date +%s)" > /tmp/tpu_status
+  sleep "$INTERVAL"
+done

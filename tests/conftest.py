@@ -3,23 +3,15 @@
 This preserves the reference's distributed-testing methodology — "compare an
 N-rank result against a 1-rank result" (hw5 handout §5.1, SURVEY §4.4/§4.8) —
 without cluster hardware, exactly as SURVEY §4.8 prescribes: a CPU platform
-with ``--xla_force_host_platform_device_count=8``.
-
-Note: the environment's TPU plugin re-forces its own platform list via
-``jax.config.update`` at interpreter startup (sitecustomize), so setting the
-``JAX_PLATFORMS`` env var is NOT enough — we must update the config *after*
-importing jax.  The XLA_FLAGS env var must still be set *before* the CPU
-client is created.
+with ``--xla_force_host_platform_device_count=8``.  The order-sensitive
+platform-forcing recipe lives in ``cme213_tpu.core.platform``.
 """
 
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from cme213_tpu.core.platform import force_cpu_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8)
