@@ -24,12 +24,9 @@ from ..core import PhaseTimer, bandwidth_gbs, gflops
 from ..dist import mesh_for_method, run_distributed_heat
 from ..grid import make_initial_grid, save_grid_to_file
 from ..ops import run_heat
+from ..ops.stencil import flops_per_point
 from ..ops.stencil_pallas import pick_tile, run_heat_pallas
 from ..verify import check_ulp, golden
-
-# flops per interior point per iteration: 2 axes × (taps mul + taps-1 add)
-# + combine (2 mul + 2 add)
-_FLOPS_PER_POINT = {2: 2 * 5 + 4, 4: 2 * 9 + 4, 8: 2 * 17 + 4}
 
 
 @dataclass
@@ -41,7 +38,7 @@ class HeatResult:
 def _report(params: SimParams, label: str, ms: float) -> str:
     per_iter = ms / params.iters
     nbytes = 2 * 4 * params.nx * params.ny
-    nflops = _FLOPS_PER_POINT[params.order] * params.nx * params.ny
+    nflops = flops_per_point(params.order) * params.nx * params.ny
     return (f"{label}: {ms:.1f} ms total, "
             f"{bandwidth_gbs(nbytes, per_iter):.2f} GB/s, "
             f"{gflops(nflops, per_iter):.2f} GFLOP/s")

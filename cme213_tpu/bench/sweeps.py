@@ -78,9 +78,9 @@ def pagerank_avg_edges_sweep(num_nodes: int = 1 << 18,
     rows = []
     for avg in edges_range:
         g = build_graph(num_nodes, avg, seed=avg)
-        # timed run (compile absorbed by a warmup call inside run via
-        # explicit pre-run)
-        run_pagerank(g, 2)
+        # warm up with the SAME iteration count: it is a static jit arg, so
+        # any other count would leave compilation inside the timed bracket
+        np.asarray(run_pagerank(g, iterations))
         t0 = time.perf_counter()
         out = run_pagerank(g, iterations)
         np.asarray(out)
@@ -96,37 +96,60 @@ def pagerank_avg_edges_sweep(num_nodes: int = 1 << 18,
 
 
 def heat_sweep(sizes=(1000, 2000, 4000), orders=(2, 4, 8),
-               iters: int = 100) -> list[dict]:
+               iters: int = 100, dtype: str = "f32",
+               ks=(1, 8)) -> list[dict]:
+    """Grid sizes × orders × kernels × dtype — the ``data/data.ods`` table.
+
+    ``dtype='f64'`` reproduces the reference's double-precision rows
+    (``jax_enable_x64`` must be on); the Pallas pipeline kernels are
+    f32-only on TPU, so f64 rows measure the XLA kernel alone.
+    """
     import jax
     import jax.numpy as jnp
 
     from ..config import SimParams
     from ..grid import make_initial_grid
     from ..ops import run_heat
-    from ..ops.stencil_pallas import pick_tile, run_heat_pallas
+    from ..ops.stencil import flops_per_point
+    from ..ops.stencil_pipeline import pick_pipeline_tile, run_heat_pipeline
 
-    flops_pt = {2: 14, 4: 22, 8: 38}
     interpret = jax.devices()[0].platform != "tpu"
+    if dtype == "f64":
+        assert jax.config.jax_enable_x64, (
+            "heat_sweep(dtype='f64') requires jax_enable_x64 — without it "
+            "jnp silently downcasts to f32 and the GB/s column doubles")
+    jdt = {"f32": jnp.float32, "f64": jnp.float64}[dtype]
+    elem = jnp.dtype(jdt).itemsize
     rows = []
     for n in sizes:
         for order in orders:
             p = SimParams(nx=n, ny=n, order=order, iters=iters)
-            u0 = make_initial_grid(p, dtype=jnp.float32)
-            nbytes = 2 * 4 * n * n * iters
-            nflops = flops_pt[order] * n * n * iters
-            for label, runner in [
-                ("xla", lambda u: run_heat(u, iters, order, p.xcfl, p.ycfl)),
-                ("pallas", lambda u: run_heat_pallas(
-                    u, iters, order, p.xcfl, p.ycfl,
-                    tile_y=pick_tile(n), interpret=interpret)),
-            ]:
+            u0 = np.asarray(make_initial_grid(p, dtype=jdt))
+            cands = [("xla", iters,
+                      lambda u: run_heat(u, iters, order, p.xcfl, p.ycfl))]
+            if dtype == "f32":
+                for k in ks:
+                    # round the count down to a multiple of k rather than
+                    # silently dropping the kernel from the table
+                    it_k = iters - iters % k
+                    if not it_k:
+                        continue
+                    ty = pick_pipeline_tile(p.gy, k, order)
+                    cands.append((f"pipeline-k{k}", it_k,
+                                  lambda u, k=k, ty=ty, it=it_k:
+                                  run_heat_pipeline(
+                                      u, it, order, p.xcfl, p.ycfl, p.bc,
+                                      k=k, tile_y=ty, interpret=interpret)))
+            for label, n_it, runner in cands:
+                nbytes = 2 * elem * n * n * n_it
+                nflops = flops_per_point(order) * n * n * n_it
                 jax.block_until_ready(runner(jnp.array(u0)))
                 t0 = time.perf_counter()
                 jax.block_until_ready(runner(jnp.array(u0)))
                 ms = (time.perf_counter() - t0) * 1e3
                 rows.append({
                     "size": n, "order": order, "kernel": label,
-                    "ms": round(ms, 2),
+                    "dtype": dtype, "iters": n_it, "ms": round(ms, 2),
                     "gbs": round(nbytes / 1e9 / (ms / 1e3), 2),
                     "gflops": round(nflops / 1e9 / (ms / 1e3), 2),
                 })
@@ -210,29 +233,44 @@ def heat_kernel_sweep(size: int = 4000, order: int = 8,
     from ..ops.stencil_pallas import (pick_tile, run_heat_multistep,
                                       run_heat_pallas)
 
+    from ..ops.stencil_pipeline import pick_pipeline_tile, run_heat_pipeline
+
     interpret = jax.devices()[0].platform != "tpu"
     p = SimParams(nx=size, ny=size, order=order, iters=iters)
     u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
     t = tile or pick_tile(p.ny, 200)
-    nbytes = 2 * 4 * size * size * iters
+    # the conv formulation is ~200× slower per iter; a full-length run
+    # would outlive the tunnel's single-execution RPC deadline (the
+    # BENCH_r02 failure), so it gets a short run and scaled accounting
+    conv_iters = min(iters, 8)
 
     cands = {
-        "xla": lambda u: run_heat(u, iters, order, p.xcfl, p.ycfl),
-        "xla-conv": lambda u: run_heat_conv(u, iters, order, p.xcfl,
-                                            p.ycfl),
-        "pallas": lambda u: run_heat_pallas(u, iters, order, p.xcfl,
-                                            p.ycfl, tile_y=t,
-                                            interpret=interpret),
+        "xla": (iters, lambda u: run_heat(u, iters, order, p.xcfl, p.ycfl)),
+        "xla-conv": (conv_iters,
+                     lambda u: run_heat_conv(u, conv_iters, order, p.xcfl,
+                                             p.ycfl)),
+        "pallas-roll": (iters,
+                        lambda u: run_heat_pallas(u, iters, order, p.xcfl,
+                                                  p.ycfl, tile_y=t,
+                                                  interpret=interpret)),
     }
+    for k in (1,) + tuple(ks):
+        if iters % k == 0:
+            ty = pick_pipeline_tile(p.gy, k, order)
+            cands[f"pipeline-k{k}"] = (
+                iters, lambda u, k=k, ty=ty: run_heat_pipeline(
+                    u, iters, order, p.xcfl, p.ycfl, p.bc, k=k, tile_y=ty,
+                    interpret=interpret))
     for k in ks:
         if iters % k == 0:
             cands[f"pallas-k{k}"] = (
-                lambda u, k=k: run_heat_multistep(
+                iters, lambda u, k=k: run_heat_multistep(
                     u, iters, order, p.xcfl, p.ycfl, p.bc, k=k, tile_y=t,
                     interpret=interpret))
 
     rows = []
-    for name, fn in cands.items():
+    for name, (n_it, fn) in cands.items():
+        nbytes = 2 * 4 * size * size * n_it
         try:
             jax.block_until_ready(fn(jnp.array(u0)))  # same-iters warmup
             t0 = time.perf_counter()

@@ -41,6 +41,19 @@ STENCIL_COEFFS = {
 BORDER_FOR_ORDER = {2: 1, 4: 2, 8: 4}
 
 
+def flops_per_point(order: int) -> int:
+    """Flops per grid point per timestep for the given stencil order.
+
+    Per axis: one multiply per tap and one add per accumulation
+    (``taps - 1``); the combine ``u + xcfl*accx + ycfl*accy`` adds 2
+    multiplies and 2 adds.  Shared by ``bench.py`` and the sweep drivers so
+    GF/s columns stay correct across orders (order 8 → the reference's
+    38 flops/point accounting, ``hw/hw2/programming/data/data.ods``).
+    """
+    taps = len(STENCIL_COEFFS[order])
+    return 2 * taps + 2 * (taps - 1) + 4
+
+
 def stencil_interior(u: jnp.ndarray, order: int, xcfl, ycfl) -> jnp.ndarray:
     """New interior values (ny, nx) from a full halo grid (gy, gx)."""
     coeffs = STENCIL_COEFFS[order]

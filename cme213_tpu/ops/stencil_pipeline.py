@@ -1,0 +1,176 @@
+"""Pipelined Pallas heat stencil — the tuned kernel path, v2.
+
+TPU-native analog of the reference's hand-tuned shared-memory stencil
+(``gpuShared``, ``hw/hw2/programming/2dHeat.cu:466-515``): where 128×4 CUDA
+threads cooperatively staged a 128×32 halo tile into ``__shared__``, here
+each Pallas grid step receives a full-width row band in VMEM and emits a
+``(tile_y, W)`` output tile.  Unlike ``stencil_pallas.py`` (hand-rolled HBM
+DMA + double buffering), this version rides Pallas's *automatic* pipelining:
+the halo rows arrive through three input refs of the same array — a
+``(Kpad, W)`` band above, the ``(tile_y, W)`` center, and a ``(Kpad, W)``
+band below — whose blocks Pallas prefetches and double-buffers for us.
+Overlap of DMA and compute therefore comes from the pipeline emitter, not
+manual semaphore code, and Mosaic sees simple VMEM refs.
+
+One kernel covers both the plain stencil (``k=1``) and temporal blocking
+(``k>1``: k timesteps fused per HBM pass, the arithmetic-intensity
+multiplier the 48 KB shared memories of the reference's GPU era could not
+hold enough halo for).  Per k-block the band carries ``K = k·border`` extra
+rows of halo each side (padded to the 8-row sublane quantum); validity
+shrinks by ``border`` rows per sub-step, exactly covering the margin, and
+the Dirichlet bands are re-imposed between sub-steps in the reference's
+band order (bottom/top rows, then left/right columns overwriting the
+corners — ``2dHeat.cu:326-344``).
+
+Shift mechanics: ±border shifts are ``pltpu.roll`` circular rotations of
+the whole band.  Lane wrap-around lands in the ≥``gx-border`` column region
+(Dirichlet + lane padding), which the masking rewrites every sub-step, so
+wrapped values are never observed; sublane wrap lands outside the validity
+margin.  Interior results are bitwise-identical to the XLA shifted-slice
+path (``ops/stencil.py``) — same coefficients, same accumulation order.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .stencil import BORDER_FOR_ORDER, STENCIL_COEFFS
+
+LANE = 128
+SUBLANE = 8
+
+
+def _ceil_to(n: int, q: int) -> int:
+    return -(-n // q) * q
+
+
+def _roll(u, shift: int, axis: int, interpret: bool):
+    if shift == 0:
+        return u
+    if interpret:  # pltpu.roll has no interpret-mode rule; jnp.roll matches
+        return jnp.roll(u, shift, axis)
+    return pltpu.roll(u, shift % u.shape[axis], axis)
+
+
+def _make_kernel(order: int, k: int, tile_y: int, kpad: int, gy: int, gx: int,
+                 bc: tuple[float, float, float, float], xcfl: float,
+                 ycfl: float, interpret: bool):
+    b = BORDER_FOR_ORDER[order]
+    coeffs = STENCIL_COEFFS[order]
+    bc_bottom, bc_left, bc_top, bc_right = (bc[2], bc[1], bc[0], bc[3])
+
+    def kernel(top_ref, mid_ref, bot_ref, out_ref):
+        i = pl.program_id(0)
+        band = jnp.concatenate([top_ref[:], mid_ref[:], bot_ref[:]], axis=0)
+        H, W = band.shape
+        dtype = band.dtype
+        # global grid row of band-local row j is  i*tile_y - kpad + j
+        rows = (jax.lax.broadcasted_iota(jnp.int32, (H, W), 0)
+                + i * tile_y - kpad)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (H, W), 1)
+        u = band
+        for _ in range(k):
+            accx = jnp.zeros_like(u)
+            accy = jnp.zeros_like(u)
+            for kk, c in enumerate(coeffs):
+                c = jnp.asarray(c, dtype)
+                accx = accx + c * _roll(u, b - kk, 1, interpret)
+                accy = accy + c * _roll(u, b - kk, 0, interpret)
+            new = (u + jnp.asarray(xcfl, dtype) * accx
+                   + jnp.asarray(ycfl, dtype) * accy)
+            # Dirichlet re-imposition, reference band order: rows first,
+            # then columns overwrite the corners.  This also launders the
+            # clamped-edge-block duplicate rows (they sit at global rows
+            # < b or >= gy - b) and the lane padding / roll wrap region.
+            new = jnp.where(rows < b, jnp.asarray(bc_bottom, dtype), new)
+            new = jnp.where(rows >= gy - b, jnp.asarray(bc_top, dtype), new)
+            new = jnp.where(cols < b, jnp.asarray(bc_left, dtype), new)
+            new = jnp.where(cols >= gx - b, jnp.asarray(bc_right, dtype), new)
+            u = new
+        # output rows are band rows [kpad, kpad + tile_y)
+        out_ref[:] = _roll(u, -kpad, 0, interpret)[:tile_y, :]
+
+    return kernel
+
+
+@partial(jax.jit,
+         static_argnames=("order", "iters", "k", "xcfl", "ycfl", "bc",
+                          "tile_y", "interpret"),
+         donate_argnums=(0,))
+def run_heat_pipeline(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
+                      bc: tuple[float, float, float, float], k: int = 1,
+                      tile_y: int = 256,
+                      interpret: bool = False) -> jnp.ndarray:
+    """``iters`` timesteps of the pipelined Pallas stencil.
+
+    ``u`` is the (gy, gx) halo grid from ``make_initial_grid``; ``bc`` is
+    ``SimParams.bc`` = (top, left, bottom, right).  ``iters`` must divide
+    by ``k``.  ``tile_y`` must be a multiple of the halo band height
+    ``Kpad = ceil8(k·border)`` (so the halo refs index on block boundaries).
+    Returns the full (gy, gx) halo grid after ``iters`` steps, bitwise
+    equal on the interior to ``run_heat``.
+    """
+    b = BORDER_FOR_ORDER[order]
+    K = k * b
+    kpad = _ceil_to(K, SUBLANE)
+    gy, gx = u.shape
+    assert iters % k == 0, "iters must divide by k"
+    assert tile_y % kpad == 0, "tile_y must divide by ceil8(k*border)"
+    W = _ceil_to(gx, LANE)
+    GY = _ceil_to(gy, tile_y)
+    # x-roll wrap safety needs W - gx + b >= b, i.e. wrapped lanes land in
+    # the [gx - b, W) region the masking rewrites every sub-step — always
+    # true since W >= gx, no matter how the lane padding falls
+    bc_top, bc_left, bc_bottom, bc_right = bc
+
+    # pad columns with bc_right and rows with bc_top: the padding then holds
+    # exactly the values the in-kernel masking rewrites, so it is a fixed
+    # point of the iteration and the [0:gy, 0:gx] corner is undisturbed
+    padded = u
+    if W != gx:
+        padded = jnp.pad(padded, ((0, 0), (0, W - gx)),
+                         constant_values=bc_right)
+    if GY != gy:
+        padded = jnp.pad(padded, ((0, GY - gy), (0, 0)),
+                         constant_values=bc_top)
+
+    nblk = GY // tile_y
+    t_per_k = tile_y // kpad  # halo-block indices per center block
+    kernel = _make_kernel(order, k, tile_y, kpad, gy, gx, bc,
+                          float(xcfl), float(ycfl), interpret)
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((GY, W), u.dtype),
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((kpad, W), lambda i: (jnp.maximum(i * t_per_k - 1, 0), 0)),
+            pl.BlockSpec((tile_y, W), lambda i: (i, 0)),
+            pl.BlockSpec((kpad, W),
+                         lambda i: (jnp.minimum((i + 1) * t_per_k,
+                                                GY // kpad - 1), 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_y, W), lambda i: (i, 0)),
+        interpret=interpret,
+    )
+
+    def body(_, p):
+        return call(p, p, p)
+
+    padded = lax.fori_loop(0, iters // k, body, padded)
+    return padded[:gy, :gx]
+
+
+def pick_pipeline_tile(gy: int, k: int, order: int,
+                       target: int = 256) -> int:
+    """A tile_y that is a multiple of Kpad and keeps the band in VMEM."""
+    b = BORDER_FOR_ORDER[order]
+    kpad = _ceil_to(k * b, SUBLANE)
+    t = max(_ceil_to(min(target, gy), kpad), kpad)
+    return t

@@ -65,8 +65,10 @@ def test_pagerank_sweep():
 
 
 def test_heat_sweep():
-    rows = heat_sweep(sizes=(32,), orders=(2,), iters=3)
-    assert {r["kernel"] for r in rows} == {"xla", "pallas"}
+    rows = heat_sweep(sizes=(32,), orders=(2,), iters=4, ks=(1, 2))
+    assert {r["kernel"] for r in rows} == {"xla", "pipeline-k1",
+                                           "pipeline-k2"}
+    assert all(r["dtype"] == "f32" for r in rows)
 
 
 def test_sort_thread_sweep():
