@@ -38,15 +38,11 @@ _MAX_ITERS = 400
 
 
 def _apply_platform_env() -> None:
-    """Honor an explicit JAX_PLATFORMS env var.  This environment's
-    sitecustomize re-forces its own platform list at interpreter startup,
-    so the env var alone is overridden — it must be re-applied through
-    jax.config (same defense as ``core/platform.force_cpu_devices``)."""
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        import jax
+    """Honor an explicit JAX_PLATFORMS env var (this environment's
+    sitecustomize otherwise overrides it — see core/platform)."""
+    from cme213_tpu.core.platform import apply_platform_env
 
-        jax.config.update("jax_platforms", plat)
+    apply_platform_env()
 
 
 def _preflight(seconds: float = 90.0) -> bool:
@@ -83,8 +79,13 @@ def _make_candidate(name: str, params, on_tpu: bool):
         return (lambda u, it: run_heat_conv(u, it, order, params.xcfl,
                                             params.ycfl), 1)
     if name.startswith("pipeline-k"):
+        from cme213_tpu.ops.stencil_pipeline import pick_pipeline_tile
+
         k = int(name.split("pipeline-k")[1])
-        tile_y = int(os.environ.get("BENCH_TILE_Y", "256"))
+        # BENCH_TILE_Y is a target; round it to a valid multiple of the
+        # halo quantum so an arbitrary override can't trip the tile assert
+        target = int(os.environ.get("BENCH_TILE_Y", "256"))
+        tile_y = pick_pipeline_tile(params.gy, k, order, target=target)
         return (lambda u, it: run_heat_pipeline(
             u, it, order, params.xcfl, params.ycfl, params.bc, k=k,
             tile_y=tile_y, interpret=not on_tpu), k)
@@ -124,6 +125,11 @@ def measure_one(name: str, dtype_name: str) -> dict:
         # only the fused-XLA kernel is meaningful off-TPU
         return {"kernel": name, "ok": False, "platform": dev.platform,
                 "error": "skipped: not on TPU"}
+    if dtype_name == "f64" and name != "xla":
+        # TPU Pallas/Mosaic has no f64 lowering and the conv path is
+        # f32-tuned; the reference's double rows measure one kernel too
+        return {"kernel": name, "ok": False, "platform": dev.platform,
+                "error": "skipped: f64 is XLA-only"}
 
     fn, quantum = _make_candidate(name, params, on_tpu)
 
@@ -173,7 +179,8 @@ def run_children(dtype_name: str, budget_s: float = 2700.0) -> list[dict]:
     rows = []
     dead_streak = 0
     platform = None
-    for name in KERNELS:
+    kernels = ("xla",) if dtype_name == "f64" else KERNELS
+    for name in kernels:
         if platform is not None and platform != "tpu" and name != "xla":
             rows.append({"kernel": name, "ok": False,
                          "error": "skipped: not on TPU"})

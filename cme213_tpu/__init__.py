@@ -22,3 +22,10 @@ of the Stanford CME213 (Spring 2012) parallel-workload suite (see SURVEY.md):
 """
 
 __version__ = "0.1.0"
+
+# make JAX_PLATFORMS authoritative for every CLI/driver in this package
+# (this environment's sitecustomize otherwise overrides it; a wedged TPU
+# tunnel would then hang runs that explicitly asked for CPU)
+from .core.platform import apply_platform_env as _apply_platform_env
+
+_apply_platform_env()

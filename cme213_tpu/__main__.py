@@ -1,0 +1,12 @@
+"""Top-level CLI: ``python -m cme213_tpu <workload> [args...]``.
+
+One entry point over the six workload drivers (the reference shipped six
+separate binaries; the registry in ``models.py`` is the single place they
+are enumerated)."""
+
+import sys
+
+from .models import dispatch
+
+if __name__ == "__main__":
+    sys.exit(dispatch(sys.argv[1:]))

@@ -133,7 +133,8 @@ def run_distributed(params: SimParams, num_devices: int | None = None,
 
 
 def main(argv: list[str]) -> int:
-    path = argv[1] if len(argv) > 1 else "params.in"
+    paths = [a for a in argv[1:] if not a.startswith("--")]
+    path = paths[0] if paths else "params.in"
     distributed = "--distributed" in argv
     params = SimParams.from_file(path, distributed=distributed)
     if distributed:

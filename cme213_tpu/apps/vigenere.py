@@ -40,20 +40,24 @@ _E_MINUS_A = ord("e") - ord("a")
 
 @jax.jit
 def _sanitize_device(raw: jnp.ndarray):
-    """Lowercase + keep-mask + compaction positions (one fused pass)."""
+    """Lowercase + keep-mask + scatter compaction (all on device).
+
+    Rejected bytes scatter into a sacrificial slot one past the end of an
+    (n+1)-wide buffer, so they can never collide with a kept byte; the
+    caller slices the compacted prefix.  One fused pass — the analog of
+    the reference's single ``remove_copy_if`` over a ``transform_iterator``
+    (create_cipher.cu:111-113).
+    """
+    n = raw.shape[0]
     # upper_to_lower: 'A'-'Z' -> 'a'-'z' (create_cipher.cu:31-38)
     is_upper = (raw >= ord("A")) & (raw <= ord("Z"))
     low = jnp.where(is_upper, raw + (ord("a") - ord("A")), raw)
     keep = (low >= ord("a")) & (low <= ord("z"))
     pos = exclusive_scan(keep.astype(jnp.int32))
-    out = jnp.zeros_like(low)
-    out = out.at[jnp.where(keep, pos, raw.shape[0] - 1)].set(
-        jnp.where(keep, low, 0), mode="drop"
-    )
-    # the scatter above may be overwritten at slot n-1 by dropped writes;
-    # redo the last valid slot deterministically
+    out = jnp.zeros(n + 1, dtype=low.dtype)
+    out = out.at[jnp.where(keep, pos, n)].set(jnp.where(keep, low, 0))
     count = pos[-1] + keep[-1].astype(jnp.int32)
-    return out, count
+    return out[:-1], count
 
 
 def sanitize(raw: np.ndarray) -> np.ndarray:
@@ -61,14 +65,7 @@ def sanitize(raw: np.ndarray) -> np.ndarray:
     sanitizer).  Returns the compacted uint8 array."""
     raw = np.asarray(raw, dtype=np.uint8)
     out, count = _sanitize_device(jnp.asarray(raw))
-    n = int(count)
-    packed = np.array(out[:n])
-    # guard against the drop-slot collision at the tail
-    if n:
-        low = np.where((raw >= 65) & (raw <= 90), raw + 32, raw)
-        valid = low[(low >= 97) & (low <= 122)]
-        packed[-1] = valid[-1]
-    return packed
+    return np.array(out[: int(count)])
 
 
 # ---------------------------------------------------------------- key gen

@@ -396,7 +396,14 @@ def scan_sweep(n: int = 1 << 26, num_segments: int = 1 << 16) -> list[dict]:
 
 
 def spmv_suite_sweep(names=None, scale: float = 0.05,
-                     kernels=("flat",)) -> list[dict]:
+                     kernels=("flat",), cpu_threads: int | None = 4) -> list[dict]:
+    """Device kernels vs the OpenMP CPU reference over the suite.
+
+    ``cpu_threads`` adds the reference's CPU measurement axis (4-thread
+    table, ``hw/hw_final/programming/data.ods`` table 2 / ``fp.cu:130-152``)
+    as a ``cpu_ms`` column; ``None`` skips it.
+    """
+    from .. import native
     from ..apps import spmv_scan as sp
     from ..core import PhaseTimer
 
@@ -404,14 +411,30 @@ def spmv_suite_sweep(names=None, scale: float = 0.05,
     rows = []
     for name in names:
         prob = sp.suite_problem(name, scale=scale)
+        cpu_ms = None
+        if cpu_threads is not None:
+            prev = native.thread_count()
+            try:
+                native.set_threads(cpu_threads)
+                native.spmv_scan_cpu(prob.a, prob.s[:-1], prob.xx, 1)  # warm
+                t0 = time.perf_counter()
+                native.spmv_scan_cpu(prob.a, prob.s[:-1], prob.xx,
+                                     prob.iters)
+                cpu_ms = (time.perf_counter() - t0) * 1e3
+            finally:
+                native.set_threads(prev)
         for kernel in kernels:
             timer = PhaseTimer()
             out = sp.run_spmv_scan(prob, timer=timer, kernel=kernel)
             errs = sp.external_check(prob, out)
-            rows.append({
+            row = {
                 "matrix": name, "kernel": kernel, "n": prob.n, "p": prob.p,
                 "iters": prob.iters,
                 "ms": round(timer.last_ms("spmv_scan"), 3),
                 "rel_l2": f"{errs['rel_l2']:.2e}",
-            })
+            }
+            if cpu_ms is not None:
+                row["cpu_ms"] = round(cpu_ms, 3)
+                row["cpu_threads"] = cpu_threads
+            rows.append(row)
     return rows

@@ -23,6 +23,23 @@ import re
 _FLAG = "--xla_force_host_platform_device_count"
 
 
+def apply_platform_env() -> None:
+    """Honor an explicit ``JAX_PLATFORMS`` env var.
+
+    This environment's sitecustomize re-forces its own platform list at
+    interpreter startup, so the env var alone is silently overridden — and
+    a wedged TPU tunnel then hangs ``jax.devices()`` even for runs that
+    asked for CPU.  Re-applying the value through ``jax.config`` (before
+    any backend client exists) restores the standard env-var semantics.
+    No-op when ``JAX_PLATFORMS`` is unset.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
 def force_cpu_devices(n_devices: int) -> None:
     """Pin JAX to the CPU platform with at least ``n_devices`` host devices.
 

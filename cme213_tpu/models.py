@@ -1,10 +1,110 @@
-"""Workload-family index (alias module).
+"""Workload registry — the framework's model-family index.
 
-The framework's "model families" are the six reference workloads; their
-canonical homes are the driver modules in ``cme213_tpu.apps``.  This module
-re-exports them under one roof for discoverability.
+The framework's "model families" are the six reference workloads
+(SURVEY §0 table); each registry entry names its driver module's CLI
+entry point and the reference unit it rebuilds.  ``python -m cme213_tpu
+<workload> [args...]`` dispatches through this table (see ``__main__.py``).
 """
 
-from .apps import cipher, heat2d, pagerank, sorts, spmv_scan, vigenere
+from __future__ import annotations
 
-__all__ = ["cipher", "heat2d", "pagerank", "sorts", "spmv_scan", "vigenere"]
+import sys
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass(frozen=True)
+class Workload:
+    name: str
+    reference_unit: str
+    summary: str
+    run: Callable[[list[str]], int]
+
+
+def _cipher(argv: list[str]) -> int:
+    from .apps import cipher
+
+    return cipher.main(["cipher", *argv])
+
+
+def _pagerank(argv: list[str]) -> int:
+    from .apps import pagerank
+
+    known = ("num_nodes", "avg_edges", "iterations", "seed")
+    kwargs = {}
+    for a in argv:
+        if not (a.startswith("--") and "=" in a):
+            print(f"pagerank: unknown argument {a!r} "
+                  f"(expected --key=value with key in {known})",
+                  file=sys.stderr)
+            return 2
+        key, value = a[2:].split("=", 1)
+        key = key.replace("-", "_")
+        if key not in known:
+            print(f"pagerank: unknown option --{key}", file=sys.stderr)
+            return 2
+        kwargs[key] = int(value)
+    return 0 if pagerank.main(**kwargs) else 1
+
+
+def _heat2d(argv: list[str]) -> int:
+    from .apps import heat2d
+
+    return heat2d.main(["heat2d", *argv])
+
+
+def _vigenere(argv: list[str]) -> int:
+    from .apps import vigenere
+
+    return vigenere.main(["vigenere", *argv])
+
+
+def _sorts(argv: list[str]) -> int:
+    from .apps import sorts
+
+    return sorts.main(["sorts", *argv])
+
+
+def _spmv_scan(argv: list[str]) -> int:
+    from .apps import spmv_scan
+
+    return spmv_scan.main(["spmv_scan", *argv])
+
+
+WORKLOADS: dict[str, Workload] = {
+    w.name: w
+    for w in (
+        Workload("cipher", "hw1", "Caesar shift cipher (device bandwidth "
+                 "ladder: 1/4/8-byte lanes)", _cipher),
+        Workload("pagerank", "hw1", "CSR PageRank iteration vs host golden",
+                 _pagerank),
+        Workload("heat2d", "hw2/hw5", "2-D heat diffusion: XLA + Pallas "
+                 "kernels, optional --distributed mesh run", _heat2d),
+        Workload("vigenere", "hw3", "Vigenère create/crack via device "
+                 "analytics pipelines", _vigenere),
+        Workload("sorts", "hw4", "host OpenMP merge/radix sorts + "
+                 "TPU-resident sort path", _sorts),
+        Workload("spmv_scan", "hw_final", "iterated gather·multiply + "
+                 "segmented scan engine", _spmv_scan),
+    )
+}
+
+
+def usage() -> str:
+    lines = ["usage: python -m cme213_tpu <workload> [args...]", "",
+             "workloads:"]
+    for w in WORKLOADS.values():
+        lines.append(f"  {w.name:<10} [{w.reference_unit}] {w.summary}")
+    return "\n".join(lines)
+
+
+def dispatch(argv: list[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(usage())
+        return 0
+    name = argv[0]
+    w = WORKLOADS.get(name)
+    if w is None:
+        print(f"unknown workload {name!r}\n\n{usage()}", file=sys.stderr)
+        return 2
+    return w.run(argv[1:])

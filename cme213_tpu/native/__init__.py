@@ -55,6 +55,8 @@ def _load():
         _lib.write_floats.argtypes = [ctypes.c_char_p, f32p,
                                       ctypes.c_longlong]
         _lib.write_floats.restype = ctypes.c_int
+        _lib.spmv_scan_omp.argtypes = [f32p, f32p, i32p, ctypes.c_long,
+                                       ctypes.c_long, ctypes.c_int]
     return _lib
 
 
@@ -140,6 +142,22 @@ def saxpy(alpha: float, x: np.ndarray, y: np.ndarray) -> np.ndarray:
     assert y.dtype == np.float32 and y.flags["C_CONTIGUOUS"]
     _load().saxpy_omp(alpha, x, y, x.size)
     return y
+
+
+def spmv_scan_cpu(a: np.ndarray, seg_starts: np.ndarray, xx: np.ndarray,
+                  iters: int) -> np.ndarray:
+    """OpenMP CPU SpMV-scan: ``a ← segscan(a·xx)`` iterated ``iters`` times.
+
+    The hw_final CPU reference axis (parallel multiply + one-segment-per-
+    thread serial scan, ``fp.cu:130-152``).  ``seg_starts`` excludes the
+    terminal sentinel.  Returns a new array; ``a`` is untouched.
+    """
+    lib = _load()
+    out = np.array(a, dtype=np.float32, copy=True, order="C")
+    xx = np.ascontiguousarray(xx, dtype=np.float32)
+    s = np.ascontiguousarray(seg_starts, dtype=np.int32)
+    lib.spmv_scan_omp(out, xx, s, s.size, out.size, iters)
+    return out
 
 
 def set_threads(n: int) -> None:

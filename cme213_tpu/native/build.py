@@ -13,7 +13,7 @@ import subprocess
 from pathlib import Path
 
 _HERE = Path(__file__).resolve().parent
-_SRCS = [_HERE / "sorts.cpp", _HERE / "io.cpp"]
+_SRCS = [_HERE / "sorts.cpp", _HERE / "io.cpp", _HERE / "spmv.cpp"]
 _LIB = _HERE / "_libsorts.so"
 
 

@@ -126,13 +126,14 @@ void radix_pass_parallel(const uint32_t* in, uint32_t* out, long n, int shift,
     }
   }
 
-  // phase 4: parallel stable scatter using each block's bases
+  // phase 4: parallel stable scatter — each block owns its `base` slice,
+  // which is dead after this phase, so it doubles as the scatter cursor
+  // (no per-thread 2^num_bits stack/heap copy)
 #pragma omp parallel for schedule(static)
   for (long blk = 0; blk < nblocks; ++blk) {
     long lo = blk * block_size;
     long hi = std::min(n, lo + block_size);
-    long cursor[1 << 16];  // max num_bits = 16
-    std::memcpy(cursor, &base[blk * nbuckets], nbuckets * sizeof(long));
+    long* cursor = &base[blk * nbuckets];
     for (long i = lo; i < hi; ++i) {
       uint32_t d = (in[i] >> shift) & mask;
       out[cursor[d]++] = in[i];

@@ -1,0 +1,141 @@
+"""Multi-host startup layer (strategy P12) — exercised for real.
+
+The reference's distributed entry point is ``mpirun -np N`` under
+Torque/PBS (``hw/hw5/PA5_Handout.pdf`` §4).  These tests exercise the JAX
+analog beyond a no-op: env-var parsing (launcher-provided rank/world like
+MPI), and a genuine 2-process run on the CPU backend — two subprocesses
+join a localhost coordinator via ``jax.distributed.initialize``, build a
+global 2-process × 2-device mesh, and run a ``psum`` across all 4 devices
+(the MPI_Allreduce-over-two-ranks smoke test).
+"""
+
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+
+def test_env_parsing_defaults(monkeypatch):
+    """Launcher env vars are the argument source, like MPI ranks."""
+    from cme213_tpu.dist import multihost
+
+    captured = {}
+
+    class FakeDistributed:
+        @staticmethod
+        def initialize(coordinator_address=None, num_processes=None,
+                       process_id=None):
+            captured.update(addr=coordinator_address, n=num_processes,
+                            pid=process_id)
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    monkeypatch.setattr("jax.distributed", FakeDistributed)
+    multihost.initialize_multihost()
+    assert captured == {"addr": "10.0.0.1:1234", "n": 4, "pid": 3}
+
+
+def test_env_parsing_single_process_noop(monkeypatch):
+    from cme213_tpu.dist import multihost
+
+    def boom(**kwargs):
+        raise AssertionError("initialize must not be called for 1 process")
+
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    monkeypatch.setattr("jax.distributed.initialize", boom)
+    multihost.initialize_multihost()  # no-op
+
+
+def test_explicit_args_override_env(monkeypatch):
+    from cme213_tpu.dist import multihost
+
+    captured = {}
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "8")
+    monkeypatch.setenv("JAX_PROCESS_ID", "7")
+    monkeypatch.setattr(
+        "jax.distributed.initialize",
+        lambda coordinator_address=None, num_processes=None, process_id=None:
+        captured.update(addr=coordinator_address, n=num_processes,
+                        pid=process_id))
+    multihost.initialize_multihost("127.0.0.1:9", num_processes=2,
+                                   process_id=1)
+    assert captured == {"addr": "127.0.0.1:9", "n": 2, "pid": 1}
+
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    from cme213_tpu.core.platform import force_cpu_devices
+    # 2 local CPU devices per process BEFORE the distributed client forms
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from cme213_tpu.dist.multihost import initialize_multihost, process_info
+
+    initialize_multihost()  # everything from the env, like an MPI launcher
+    pid, count = process_info()
+    assert count == 2, f"process_count={{count}}"
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    devs = jax.devices()           # global: 2 processes x 2 devices
+    assert len(devs) == 4, f"global devices={{len(devs)}}"
+    mesh = Mesh(devs, ("d",))
+
+    @jax.jit
+    def allreduce():
+        def body():
+            return jax.lax.psum(jnp.float32(jax.lax.axis_index("d") + 1),
+                                "d")
+        return shard_map(body, mesh=mesh, in_specs=(), out_specs=P())()
+
+    total = float(allreduce()[0] if allreduce().ndim else allreduce())
+    assert total == 10.0, f"psum={{total}}"   # 1+2+3+4 over 4 devices
+    print(f"rank {{pid}}/{{count}} OK psum={{total}}")
+""")
+
+
+def test_two_process_cpu_backend(tmp_path):
+    """Two real processes, localhost coordinator, global mesh, cross-process
+    psum — the 'compare against a single-rank run' methodology needs the
+    runtime to actually form, which a no-op call never showed."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(repo=repo))
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ,
+                   JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid))
+        env.pop("JAX_PLATFORMS", None)  # worker forces cpu itself
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=150)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("distributed CPU runtime unavailable in this sandbox "
+                    "(coordinator handshake timed out); run manually with "
+                    "JAX_COORDINATOR_ADDRESS=127.0.0.1:<port> "
+                    "JAX_NUM_PROCESSES=2 JAX_PROCESS_ID={0,1}")
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed: {err[-2000:]}"
+        assert "OK psum=10.0" in out

@@ -54,3 +54,59 @@ def test_thread_control():
     assert native.thread_count() == 2
     native.set_threads(4)
     assert native.thread_count() == 4
+
+
+def test_radix_sort_16bit_large():
+    """num_bits=16 (2^16 buckets) on a few million elements — exercises the
+    bucket-major scan + scatter-cursor path at its widest setting."""
+    rng = np.random.default_rng(16)
+    x = rng.integers(0, 2**32, size=3_000_000, dtype=np.uint64).astype(np.uint32)
+    ref = np.sort(x)
+    out = native.radix_sort(x.copy(), num_bits=16)
+    np.testing.assert_array_equal(out, ref)
+
+
+def _random_problem(rng, n=5000, p=64, q=40):
+    starts = np.sort(rng.choice(np.arange(1, n), size=p - 1, replace=False))
+    s = np.concatenate([[0], starts]).astype(np.int32)
+    a = rng.standard_normal(n).astype(np.float32)
+    xx = rng.uniform(-1, 1, n).astype(np.float32)
+    return a, s, xx
+
+
+def test_spmv_scan_cpu_matches_golden():
+    """OpenMP CPU SpMV-scan is bitwise-equal to the serial numpy golden
+    (same f32 serial accumulation order per segment)."""
+    from cme213_tpu.verify import golden
+
+    rng = np.random.default_rng(0)
+    a, s, xx = _random_problem(rng)
+    for iters in (1, 7):
+        ref = golden.host_spmv_scan(a, s, xx, iters)
+        out = native.spmv_scan_cpu(a, s, xx, iters)
+        np.testing.assert_array_equal(out, ref)
+
+
+def test_spmv_scan_cpu_thread_invariant():
+    """Per-segment scans are serial, so results are bitwise thread-count
+    independent (the property that makes the 4-thread table comparable)."""
+    rng = np.random.default_rng(1)
+    a, s, xx = _random_problem(rng, n=20_000, p=37)
+    prev = native.thread_count()
+    try:
+        native.set_threads(1)
+        r1 = native.spmv_scan_cpu(a, s, xx, 5)
+        native.set_threads(4)
+        r4 = native.spmv_scan_cpu(a, s, xx, 5)
+    finally:
+        native.set_threads(prev)
+    np.testing.assert_array_equal(r1, r4)
+    assert not np.array_equal(r1, a)  # it actually did something
+
+
+def test_spmv_scan_cpu_single_segment():
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal(100).astype(np.float32)
+    xx = np.ones(100, np.float32)
+    out = native.spmv_scan_cpu(a, np.array([0], np.int32), xx, 1)
+    np.testing.assert_allclose(out, np.cumsum(a, dtype=np.float32), rtol=1e-6)

@@ -114,14 +114,15 @@ def test_cli_round_trip(tmp_path, capsys):
     np.testing.assert_array_equal(plain, body)
 
 
-def test_alias_modules_import():
-    import cme213_tpu.models as m
-    import cme213_tpu.parallel as p
-    import cme213_tpu.utils as u
+def test_workload_registry():
+    from cme213_tpu.models import WORKLOADS, dispatch, usage
 
-    assert hasattr(m, "vigenere") and hasattr(m, "heat2d")
-    assert hasattr(p, "make_mesh_1d") and hasattr(p, "multihost")
-    assert hasattr(u, "PhaseTimer") and hasattr(u, "checkpoint")
+    assert set(WORKLOADS) == {"cipher", "pagerank", "heat2d", "vigenere",
+                              "sorts", "spmv_scan"}
+    assert dispatch(["--help"]) == 0
+    assert dispatch(["no-such-workload"]) == 2
+    for w in WORKLOADS.values():
+        assert w.name in usage() and w.reference_unit in usage()
 
 
 def test_crack_key_length_one():
