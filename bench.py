@@ -31,8 +31,8 @@ _CHILD_FLAG = "--run-measurement"
 _PREFLIGHT_EXIT = 42
 
 # candidate kernel names; each runs in its own child process
-KERNELS = ("xla", "xla-conv", "pipeline-k1", "pipeline-k2", "pipeline-k4",
-           "pipeline-k8")
+KERNELS = ("xla", "xla-roll", "xla-conv", "pipeline-k1", "pipeline-k2",
+           "pipeline-k4", "pipeline-k8")
 _EXEC_CAP_S = 30.0
 _MAX_ITERS = 400
 
@@ -69,12 +69,16 @@ def _preflight(seconds: float = 90.0) -> bool:
 def _make_candidate(name: str, params, on_tpu: bool):
     """Return (fn(u, iters), iters_quantum) for a kernel name."""
     from cme213_tpu.ops import run_heat, run_heat_conv
+    from cme213_tpu.ops.stencil import run_heat_roll
     from cme213_tpu.ops.stencil_pipeline import run_heat_pipeline
 
     order = params.order
     if name == "xla":
         return (lambda u, it: run_heat(u, it, order, params.xcfl,
                                        params.ycfl), 1)
+    if name == "xla-roll":
+        return (lambda u, it: run_heat_roll(u, it, order, params.xcfl,
+                                            params.ycfl, params.bc), 1)
     if name == "xla-conv":
         return (lambda u, it: run_heat_conv(u, it, order, params.xcfl,
                                             params.ycfl), 1)

@@ -130,6 +130,35 @@ def generate_problem(n: int, p: int, q: int, iters: int | None = None,
     x = rng.uniform(-1, 1, size=q).astype(np.float32)
     if iters is None:
         iters = int(rng.integers(5, 101))
+    # Normalize the iteration's growth.  Each step applies the FIXED linear
+    # map b -> segscan(b·xx); over the suite's up-to-100 iterations its
+    # spectral radius compounds, and unit-scale draws overflow f32 within
+    # tens of iterations on long segments (real SuiteSparse values — the
+    # reference's source — are not amplifying like this).  Scaling x by
+    # 1/radius makes the map growth-neutral, leaving segment structure,
+    # op counts, and timings untouched.  The radius comes from a short
+    # f64 power iteration using a vectorized segmented cumsum (global
+    # cumsum minus per-segment offset) — accumulation order is irrelevant
+    # for a radius estimate, so the serial golden isn't needed here.
+    seg_lens = np.diff(np.concatenate([s[:-1], [n]]))
+
+    def segscan64(v):
+        cs = np.cumsum(v)
+        offsets = np.concatenate([[0.0], cs[s[1:-1] - 1]])
+        return cs - np.repeat(offsets, seg_lens)
+
+    xx64 = x.astype(np.float64)[k]
+    b = a.astype(np.float64)
+    growth = 1.0
+    for _ in range(min(8, iters)):
+        prev = np.abs(b).max()
+        b = segscan64(b * xx64)
+        cur = np.abs(b).max()
+        if prev > 0 and cur > 0:
+            growth = cur / prev  # last-step ratio: the aligned radius
+            b /= cur             # keep the power iteration itself finite
+    if np.isfinite(growth) and growth > 0:
+        x = (x / growth).astype(np.float32)
     return Problem(a, s, k, x, iters)
 
 

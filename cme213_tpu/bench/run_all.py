@@ -17,9 +17,14 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="bench_results")
     ap.add_argument("--quick", action="store_true",
                     help="small sizes (CI/CPU-friendly)")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated CSV basenames (without .csv) "
+                         "to run, e.g. --only sort_threads,spmv_suite")
     args = ap.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
     q = args.quick
+    only = (set(t.strip() for t in args.only.split(",") if t.strip())
+            if args.only else None)
 
     jobs = [
         ("data_bandwidth_vector_length.csv",
@@ -62,9 +67,18 @@ def main(argv=None) -> int:
         ("spmv_suite.csv",
          lambda: sweeps.spmv_suite_sweep(
              scale=0.002 if q else 1.0,
-             kernels=("flat",) if q else ("flat", "pallas"))),
+             kernels=("flat",) if q else None)),
     ]
+    if only is not None:
+        known = {f[:-len(".csv")] for f, _ in jobs}
+        unknown = only - known
+        if unknown:
+            print(f"--only: unknown sweep name(s) {sorted(unknown)}; "
+                  f"choose from {sorted(known)}", file=sys.stderr)
+            return 2
     for fname, job in jobs:
+        if only is not None and fname[:-len(".csv")] not in only:
+            continue
         path = os.path.join(args.out, fname)
         try:
             rows = job()

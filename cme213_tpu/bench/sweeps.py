@@ -244,8 +244,13 @@ def heat_kernel_sweep(size: int = 4000, order: int = 8,
     # BENCH_r02 failure), so it gets a short run and scaled accounting
     conv_iters = min(iters, 8)
 
+    from ..ops.stencil import run_heat_roll
+
     cands = {
         "xla": (iters, lambda u: run_heat(u, iters, order, p.xcfl, p.ycfl)),
+        "xla-roll": (iters,
+                     lambda u: run_heat_roll(u, iters, order, p.xcfl,
+                                             p.ycfl, p.bc)),
         "xla-conv": (conv_iters,
                      lambda u: run_heat_conv(u, conv_iters, order, p.xcfl,
                                              p.ycfl)),
@@ -295,6 +300,10 @@ def sort_thread_sweep(num_elements: int = 1_000_000,
                          dtype=np.int64).astype(np.int32)
     rkeys = rng.integers(0, 2**32, num_elements,
                          dtype=np.uint64).astype(np.uint32)
+    # warm up: build/load the library and touch the buffers so the first
+    # timed row doesn't carry compile + page-fault cost
+    native.merge_sort(mkeys[:10_000].copy())
+    native.radix_sort(rkeys[:10_000].copy())
     rows = []
     for t in threads:
         native.set_threads(t)
@@ -396,16 +405,24 @@ def scan_sweep(n: int = 1 << 26, num_segments: int = 1 << 16) -> list[dict]:
 
 
 def spmv_suite_sweep(names=None, scale: float = 0.05,
-                     kernels=("flat",), cpu_threads: int | None = 4) -> list[dict]:
+                     kernels=None, cpu_threads: int | None = 4) -> list[dict]:
     """Device kernels vs the OpenMP CPU reference over the suite.
 
     ``cpu_threads`` adds the reference's CPU measurement axis (4-thread
     table, ``hw/hw_final/programming/data.ods`` table 2 / ``fp.cu:130-152``)
-    as a ``cpu_ms`` column; ``None`` skips it.
+    as a ``cpu_ms`` column; ``None`` skips it.  ``kernels=None`` picks
+    ``("flat", "pallas")`` on TPU but ``("flat",)`` elsewhere — the Pallas
+    segmented kernel in interpret mode at suite scale would take hours.
     """
+    import jax
+
     from .. import native
     from ..apps import spmv_scan as sp
     from ..core import PhaseTimer
+
+    if kernels is None:
+        kernels = (("flat", "pallas")
+                   if jax.devices()[0].platform == "tpu" else ("flat",))
 
     names = names or list(sp.BELL_GARLAND_SUITE)
     rows = []

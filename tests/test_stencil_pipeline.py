@@ -74,3 +74,17 @@ def test_pick_pipeline_tile():
     assert pick_pipeline_tile(4008, 8, 8) % 32 == 0
     # always at least one halo quantum
     assert pick_pipeline_tile(16, 16, 8) >= 64
+
+
+@pytest.mark.parametrize("order", [2, 8])
+def test_roll_formulation_bitwise(order):
+    """run_heat_roll (scatter-free full-grid XLA variant) vs run_heat."""
+    from cme213_tpu.ops.stencil import run_heat_roll
+
+    p = SimParams(nx=52, ny=44, order=order, iters=6, bc_top=1.5,
+                  bc_left=0.5, bc_bottom=2.0, bc_right=0.25)
+    u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+    ref = np.asarray(run_heat(jnp.array(u0), 6, order, p.xcfl, p.ycfl))
+    out = np.asarray(run_heat_roll(jnp.array(u0), 6, order, p.xcfl,
+                                   p.ycfl, p.bc))
+    np.testing.assert_array_equal(out, ref)
