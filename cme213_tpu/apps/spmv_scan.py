@@ -140,7 +140,7 @@ def generate_problem(n: int, p: int, q: int, iters: int | None = None,
     # f64 power iteration using a vectorized segmented cumsum (global
     # cumsum minus per-segment offset) — accumulation order is irrelevant
     # for a radius estimate, so the serial golden isn't needed here.
-    seg_lens = np.diff(np.concatenate([s[:-1], [n]]))
+    seg_lens = np.diff(s)  # s carries the end sentinel n as its last entry
 
     def segscan64(v):
         cs = np.cumsum(v)

@@ -1,0 +1,123 @@
+"""Multi-process launcher — the ``mpirun -np N`` / PBS layer, as a tool.
+
+The reference launches distributed runs with ``mpirun -np N ./2dHeat`` under
+Torque/PBS (``hw/hw5/PA5_Handout.pdf`` §4, ``hw/hw4/programming/pa4.pbs``).
+This is the JAX-native equivalent for single-machine and same-host testing:
+
+    python -m cme213_tpu.dist.launch --np 2 [--devices-per-proc 2] -- \
+        python my_workload.py
+
+It picks a free coordinator port, spawns N copies of the command with the
+standard launcher env (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``,
+``JAX_PROCESS_ID``) that ``dist.multihost.initialize_multihost`` consumes,
+prefixes each line of output with its rank (mpirun's ``-tag-output``), and
+exits nonzero if any rank fails (fail-fast, the MPI_Abort analog: remaining
+ranks are terminated when the first one dies).
+
+On a real multi-host TPU pod each host runs its own process via the cluster
+scheduler and ``--np``/``--proc-id`` come from it; this launcher covers the
+reference's single-node ``nodes=1:ppn=N`` placement axis and CI, where
+``--devices-per-proc`` fakes per-process chips with host CPU devices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _pump(rank: int, stream, out) -> None:
+    for line in stream:
+        out.write(f"[rank {rank}] {line}")
+        out.flush()
+
+
+def launch(np_procs: int, cmd: list[str], devices_per_proc: int | None = None,
+           coordinator: str | None = None) -> int:
+    """Spawn ``np_procs`` copies of ``cmd`` with launcher env; returns the
+    first nonzero exit code (terminating the other ranks), else 0."""
+    import time
+
+    coordinator = coordinator or f"127.0.0.1:{free_port()}"
+    procs: list[subprocess.Popen] = []
+    pumps = []
+    rc = 0
+    try:
+        for rank in range(np_procs):
+            env = dict(os.environ,
+                       JAX_COORDINATOR_ADDRESS=coordinator,
+                       JAX_NUM_PROCESSES=str(np_procs),
+                       JAX_PROCESS_ID=str(rank))
+            if devices_per_proc:
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count="
+                      f"{devices_per_proc}").strip()
+                env["JAX_PLATFORMS"] = "cpu"
+            p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True)
+            procs.append(p)
+            t = threading.Thread(target=_pump,
+                                 args=(rank, p.stdout, sys.stdout),
+                                 daemon=True)
+            t.start()
+            pumps.append(t)
+
+        # poll ALL ranks: a sequential wait() in rank order would miss a
+        # higher rank dying first (e.g. rank 1 crashing while rank 0 blocks
+        # in the coordinator handshake forever) and never fail fast
+        live = set(range(np_procs))
+        while live:
+            for i in sorted(live):
+                code = procs[i].poll()
+                if code is None:
+                    continue
+                live.discard(i)
+                if code and not rc:
+                    rc = code
+                    for q in procs:  # fail-fast: take survivors down
+                        if q.poll() is None:
+                            q.terminate()
+            if live:
+                time.sleep(0.05)
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+        for t in pumps:
+            t.join(timeout=5)
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="mpirun-style launcher for multi-process JAX runs")
+    ap.add_argument("--np", dest="np_procs", type=int, required=True,
+                    help="number of processes (MPI world size)")
+    ap.add_argument("--devices-per-proc", type=int, default=None,
+                    help="fake this many CPU devices per process "
+                         "(testing without a pod)")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port (default: 127.0.0.1:<free port>)")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="command to launch (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        ap.error("no command given (append: -- python your_script.py)")
+    return launch(args.np_procs, cmd, args.devices_per_proc,
+                  args.coordinator)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -139,3 +139,55 @@ def test_two_process_cpu_backend(tmp_path):
     for rc, out, err in outs:
         assert rc == 0, f"worker failed: {err[-2000:]}"
         assert "OK psum=10.0" in out
+
+
+_LAUNCH_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    from cme213_tpu.dist.multihost import initialize_multihost, process_info
+
+    initialize_multihost()
+    pid, count = process_info()
+    import jax.numpy as jnp
+    total = float(jnp.ones(len(jax.devices())).sum())
+    print(f"rank {{pid}}/{{count}} devices={{len(jax.devices())}} "
+          f"sum={{total}}")
+""")
+
+
+def test_launcher_two_ranks(tmp_path):
+    """The mpirun-analog launcher: 2 ranks x 2 fake devices, rank-tagged
+    output, zero exit."""
+    import os
+
+    from cme213_tpu.dist.launch import launch
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "w.py"
+    script.write_text(_LAUNCH_WORKER.format(repo=repo))
+    env_backup = os.environ.pop("JAX_PLATFORMS", None)
+    try:
+        rc = launch(2, [sys.executable, str(script)], devices_per_proc=2)
+    finally:
+        if env_backup is not None:
+            os.environ["JAX_PLATFORMS"] = env_backup
+    assert rc == 0
+
+
+def test_launcher_fail_fast(tmp_path):
+    from cme213_tpu.dist.launch import launch
+
+    script = tmp_path / "bad.py"
+    script.write_text("import sys, os\n"
+                      "sys.exit(3 if os.environ['JAX_PROCESS_ID'] == '0' "
+                      "else 0)\n")
+    rc = launch(2, [sys.executable, str(script)])
+    assert rc == 3
+
+
+def test_launcher_cli_requires_command(capsys):
+    from cme213_tpu.dist.launch import main
+
+    with pytest.raises(SystemExit):
+        main(["--np", "2", "--"])
