@@ -335,17 +335,21 @@ def dist_heat_sweep(size: int = 256, order: int = 8, iters: int = 20,
 
     rows = []
     avail = len(jax.devices())
+    schemes = [("sync", False, 1, "xla"), ("async", True, 1, "xla"),
+               ("ca-k4", False, 4, "xla")]
+    if jax.devices()[0].platform == "tpu":
+        # tuned per-shard kernel (interpret mode at sweep sizes is ~1000×)
+        schemes.append(("pallas-k4", False, 4, "pallas"))
     for nd in ndevs:
         if nd > avail:
             continue
         for method in (GridMethod.STRIPES_1D, GridMethod.BLOCKS_2D):
-            for requested, overlap, k in (("sync", False, 1),
-                                          ("async", True, 1),
-                                          ("ca-k4", False, 4)):
+            for requested, overlap, k, lk in schemes:
                 p = SimParams(nx=size, ny=size, order=order, iters=iters)
                 mesh = mesh_for_method(method, nd)
                 iterate, used_overlap, used_k = prepare_distributed_heat(
-                    p, mesh, overlap=overlap, steps_per_exchange=k)
+                    p, mesh, overlap=overlap, steps_per_exchange=k,
+                    local_kernel=lk)
                 iterate()          # warmup: same iters → same executable
                 secs, _ = iterate()  # device loop only (MPI_Wtime analog)
                 # record the scheme that actually ran: overlap and the
@@ -362,6 +366,7 @@ def dist_heat_sweep(size: int = 256, order: int = 8, iters: int = 20,
                     "method": "1D" if method == GridMethod.STRIPES_1D else "2D",
                     "scheme": scheme,
                     "requested": requested,
+                    "local_kernel": lk,
                     "seconds": round(secs, 4),
                 })
     return rows

@@ -160,6 +160,29 @@ def _multistep_local_step(block, params: SimParams, y_size: int, x_size: int,
     return p[K:K + ny_loc, K:K + nx_loc]
 
 
+def _multistep_local_step_pallas(block, params: SimParams, y_size: int,
+                                 x_size: int, k: int, tile_y: int,
+                                 interpret: bool):
+    """The tuned-kernel form of ``_multistep_local_step``: one Pallas call
+    applies k timesteps to the K-padded local block (the hw5 pattern of
+    running the hw2 optimized kernel under the communication layer).
+    Bitwise-equal to the XLA path — same taps, same accumulation order,
+    same global-coordinate BC masking."""
+    from ..ops.stencil_pipeline import stencil_local_multistep
+
+    b = params.border_size
+    K = k * b
+    ny_loc, nx_loc = block.shape
+    p = _assemble_padded(block, params, y_size, x_size, border=K)
+    gy0 = lax.axis_index("y") * ny_loc + b - K
+    gx0 = (lax.axis_index("x") if x_size > 1 else 0) * nx_loc + b - K
+    out = stencil_local_multistep(
+        p, gy0, gx0, params.ny, params.nx, params.order,
+        float(params.xcfl), float(params.ycfl), params.bc, k=k,
+        tile_y=tile_y, interpret=interpret)
+    return out[K:K + ny_loc, K:K + nx_loc]
+
+
 def distributed_heat_step(params: SimParams, mesh: Mesh, overlap: bool = False):
     """Build the sharded single-step function ``u (ny,nx) -> u'`` (interior
     arrays, sharded over ``mesh``)."""
@@ -179,15 +202,21 @@ def distributed_heat_step(params: SimParams, mesh: Mesh, overlap: bool = False):
 
 
 @partial(jax.jit, static_argnames=("params", "mesh", "iters", "overlap",
-                                   "steps_per_exchange"),
+                                   "steps_per_exchange", "local_kernel",
+                                   "tile_y"),
          donate_argnums=(0,))
-def _run(u, params, mesh, iters, overlap, steps_per_exchange=1):
+def _run(u, params, mesh, iters, overlap, steps_per_exchange=1,
+         local_kernel="xla", tile_y=128):
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
     y_size = axes.get("y", 1)
     x_size = axes.get("x", 1)
     spec = P("y", "x" if "x" in axes else None)
     k = steps_per_exchange
-    if k > 1:
+    if local_kernel == "pallas":
+        interpret = jax.devices()[0].platform != "tpu"
+        local = partial(_multistep_local_step_pallas, k=k, tile_y=tile_y,
+                        interpret=interpret)
+    elif k > 1:
         local = partial(_multistep_local_step, k=k)
     else:
         local = _overlap_local_step if overlap else _sync_local_step
@@ -197,14 +226,19 @@ def _run(u, params, mesh, iters, overlap, steps_per_exchange=1):
             0, iters // k, lambda _, g: local(g, params, y_size, x_size),
             blk)
 
+    # check_vma=False for the Pallas local kernel: varying-across-mesh
+    # tracking through interpret-mode pallas_call trips a lowering-cache
+    # bug, and the kernel neither uses collectives nor crosses shards
     return jax.shard_map(sharded_loop, mesh=mesh,
-                         in_specs=(spec,), out_specs=spec)(u)
+                         in_specs=(spec,), out_specs=spec,
+                         check_vma=local_kernel != "pallas")(u)
 
 
 def prepare_distributed_heat(params: SimParams, mesh: Mesh,
                              iters: int | None = None, dtype=jnp.float32,
                              overlap: bool | None = None,
-                             steps_per_exchange: int = 1):
+                             steps_per_exchange: int = 1,
+                             local_kernel: str = "xla"):
     """Set up a distributed solve and return ``(iterate, overlap_used,
     steps_per_exchange_used)``.
 
@@ -252,10 +286,21 @@ def prepare_distributed_heat(params: SimParams, mesh: Mesh,
         # decomposition needs ≥ 2·border rows/cols per shard
         overlap = False
 
+    if local_kernel not in ("xla", "pallas"):
+        raise ValueError(f"unknown local_kernel {local_kernel!r} "
+                         "(expected 'xla' or 'pallas')")
+    if local_kernel == "pallas":
+        overlap = False  # the Pallas local step subsumes the overlap split
     k = steps_per_exchange
     if k > 1 and (overlap or iters % k
                   or ny_loc < k * b or nx_loc < k * b):
         k = 1  # communication-avoiding path ineligible: fall back
+    tile_y = 128
+    if local_kernel == "pallas":
+        from ..ops.stencil_pipeline import pick_pipeline_tile
+
+        tile_y = pick_pipeline_tile(ny_loc + 2 * k * b, k, params.order,
+                                    target=128)
 
     full0 = make_initial_grid(params, dtype=dtype)
     u0 = np.array(interior(full0, b))
@@ -275,7 +320,8 @@ def prepare_distributed_heat(params: SimParams, mesh: Mesh,
         u = jax.device_put(jnp.asarray(u0), sharding)
         jax.block_until_ready(u)
         t0 = _time.perf_counter()
-        out = _run(u, params, mesh, iters, overlap, steps_per_exchange=k)
+        out = _run(u, params, mesh, iters, overlap, steps_per_exchange=k,
+                   local_kernel=local_kernel, tile_y=tile_y)
         jax.block_until_ready(out)
         return _time.perf_counter() - t0, out
 
@@ -285,16 +331,19 @@ def prepare_distributed_heat(params: SimParams, mesh: Mesh,
 def run_distributed_heat(params: SimParams, mesh: Mesh,
                          iters: int | None = None, dtype=jnp.float32,
                          overlap: bool | None = None,
-                         steps_per_exchange: int = 1) -> np.ndarray:
+                         steps_per_exchange: int = 1,
+                         local_kernel: str = "xla") -> np.ndarray:
     """Full distributed solve.  Returns the final full halo grid (gy, gx)
     as numpy, for direct comparison with the single-device solver and the
     reference's per-rank ``grid{rank}_final.txt`` methodology (SURVEY §4.4).
 
     ``overlap`` defaults to ``not params.synchronous`` (hw5 ``sync`` flag).
+    ``local_kernel="pallas"`` runs the tuned pipeline kernel per shard
+    (the hw5 pattern: the optimized hw2 kernel under the comm layer).
     """
     iterate, _, _ = prepare_distributed_heat(
         params, mesh, iters=iters, dtype=dtype, overlap=overlap,
-        steps_per_exchange=steps_per_exchange)
+        steps_per_exchange=steps_per_exchange, local_kernel=local_kernel)
     _, out = iterate()
     b = params.border_size
     final = np.array(make_initial_grid(params, dtype=dtype))

@@ -58,45 +58,9 @@ def _roll(u, shift: int, axis: int, interpret: bool):
     return pltpu.roll(u, shift % u.shape[axis], axis)
 
 
-def _make_kernel(order: int, k: int, tile_y: int, kpad: int, gy: int, gx: int,
-                 bc: tuple[float, float, float, float], xcfl: float,
-                 ycfl: float, interpret: bool):
-    b = BORDER_FOR_ORDER[order]
-    coeffs = STENCIL_COEFFS[order]
-    bc_bottom, bc_left, bc_top, bc_right = (bc[2], bc[1], bc[0], bc[3])
-
-    def kernel(top_ref, mid_ref, bot_ref, out_ref):
-        i = pl.program_id(0)
-        band = jnp.concatenate([top_ref[:], mid_ref[:], bot_ref[:]], axis=0)
-        H, W = band.shape
-        dtype = band.dtype
-        # global grid row of band-local row j is  i*tile_y - kpad + j
-        rows = (jax.lax.broadcasted_iota(jnp.int32, (H, W), 0)
-                + i * tile_y - kpad)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (H, W), 1)
-        u = band
-        for _ in range(k):
-            accx = jnp.zeros_like(u)
-            accy = jnp.zeros_like(u)
-            for kk, c in enumerate(coeffs):
-                c = jnp.asarray(c, dtype)
-                accx = accx + c * _roll(u, b - kk, 1, interpret)
-                accy = accy + c * _roll(u, b - kk, 0, interpret)
-            new = (u + jnp.asarray(xcfl, dtype) * accx
-                   + jnp.asarray(ycfl, dtype) * accy)
-            # Dirichlet re-imposition, reference band order: rows first,
-            # then columns overwrite the corners.  This also launders the
-            # clamped-edge-block duplicate rows (they sit at global rows
-            # < b or >= gy - b) and the lane padding / roll wrap region.
-            new = jnp.where(rows < b, jnp.asarray(bc_bottom, dtype), new)
-            new = jnp.where(rows >= gy - b, jnp.asarray(bc_top, dtype), new)
-            new = jnp.where(cols < b, jnp.asarray(bc_left, dtype), new)
-            new = jnp.where(cols >= gx - b, jnp.asarray(bc_right, dtype), new)
-            u = new
-        # output rows are band rows [kpad, kpad + tile_y)
-        out_ref[:] = _roll(u, -kpad, 0, interpret)[:tile_y, :]
-
-    return kernel
+# (the kernel factory is shared with the shard-local variant: the
+# single-device kernel is exactly _make_local_kernel with offs = (0, 0) —
+# see its definition below pick_pipeline_tile)
 
 
 @partial(jax.jit,
@@ -142,26 +106,37 @@ def run_heat_pipeline(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
 
     nblk = GY // tile_y
     t_per_k = tile_y // kpad  # halo-block indices per center block
-    kernel = _make_kernel(order, k, tile_y, kpad, gy, gx, bc,
-                          float(xcfl), float(ycfl), interpret)
-
+    # the single-device kernel is the shard-local kernel at offset (0, 0):
+    # the grid's BC/padding bands sit at global rows < b / >= b + ny (and
+    # the matching column conditions), which the masking rewrites every
+    # sub-step — keeping the padding a fixed point of the iteration
+    kernel = _make_local_kernel(order, k, tile_y, kpad, gy - 2 * b,
+                                gx - 2 * b, b, bc, float(xcfl),
+                                float(ycfl), interpret)
+    offs = jnp.zeros((2,), jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((kpad, W),
+                         lambda i, offs: (jnp.maximum(i * t_per_k - 1, 0),
+                                          0)),
+            pl.BlockSpec((tile_y, W), lambda i, offs: (i, 0)),
+            pl.BlockSpec((kpad, W),
+                         lambda i, offs: (jnp.minimum((i + 1) * t_per_k,
+                                                      GY // kpad - 1), 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_y, W), lambda i, offs: (i, 0)),
+    )
     call = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((GY, W), u.dtype),
-        grid=(nblk,),
-        in_specs=[
-            pl.BlockSpec((kpad, W), lambda i: (jnp.maximum(i * t_per_k - 1, 0), 0)),
-            pl.BlockSpec((tile_y, W), lambda i: (i, 0)),
-            pl.BlockSpec((kpad, W),
-                         lambda i: (jnp.minimum((i + 1) * t_per_k,
-                                                GY // kpad - 1), 0)),
-        ],
-        out_specs=pl.BlockSpec((tile_y, W), lambda i: (i, 0)),
+        grid_spec=grid_spec,
         interpret=interpret,
     )
 
     def body(_, p):
-        return call(p, p, p)
+        return call(offs, p, p, p)
 
     padded = lax.fori_loop(0, iters // k, body, padded)
     return padded[:gy, :gx]
@@ -174,3 +149,113 @@ def pick_pipeline_tile(gy: int, k: int, order: int,
     kpad = _ceil_to(k * b, SUBLANE)
     t = max(_ceil_to(min(target, gy), kpad), kpad)
     return t
+
+
+def _make_local_kernel(order: int, k: int, tile_y: int, kpad: int,
+                       ny: int, nx: int, border: int,
+                       bc: tuple[float, float, float, float],
+                       xcfl: float, ycfl: float, interpret: bool):
+    """Shard-local variant: BC masking keyed on per-shard GLOBAL halo-grid
+    coordinates delivered via scalar prefetch (``offs = [gy0, gx0]``, the
+    coords of array element [0, 0]).  For interior shards no mask ever
+    fires and the kernel is pure stencil; boundary shards re-impose the
+    same Dirichlet bands the single-device kernel does."""
+    b = BORDER_FOR_ORDER[order]
+    coeffs = STENCIL_COEFFS[order]
+    bc_top, bc_left, bc_bottom, bc_right = bc
+
+    def kernel(offs, top_ref, mid_ref, bot_ref, out_ref):
+        i = pl.program_id(0)
+        band = jnp.concatenate([top_ref[:], mid_ref[:], bot_ref[:]], axis=0)
+        H, W = band.shape
+        dtype = band.dtype
+        rows = (jax.lax.broadcasted_iota(jnp.int32, (H, W), 0)
+                + i * tile_y - kpad + offs[0])
+        cols = jax.lax.broadcasted_iota(jnp.int32, (H, W), 1) + offs[1]
+        u = band
+        for _ in range(k):
+            accx = jnp.zeros_like(u)
+            accy = jnp.zeros_like(u)
+            for kk, c in enumerate(coeffs):
+                c = jnp.asarray(c, dtype)
+                accx = accx + c * _roll(u, b - kk, 1, interpret)
+                accy = accy + c * _roll(u, b - kk, 0, interpret)
+            new = (u + jnp.asarray(xcfl, dtype) * accx
+                   + jnp.asarray(ycfl, dtype) * accy)
+            # same global-coordinate conditions as the sharded XLA path
+            # (dist/heat._multistep_local_step): halo-grid row/col < b or
+            # >= b + n  =>  physical Dirichlet band
+            new = jnp.where(rows < border, jnp.asarray(bc_bottom, dtype),
+                            new)
+            new = jnp.where(rows >= border + ny,
+                            jnp.asarray(bc_top, dtype), new)
+            new = jnp.where(cols < border, jnp.asarray(bc_left, dtype), new)
+            new = jnp.where(cols >= border + nx,
+                            jnp.asarray(bc_right, dtype), new)
+            u = new
+        out_ref[:] = _roll(u, -kpad, 0, interpret)[:tile_y, :]
+
+    return kernel
+
+
+def stencil_local_multistep(p: jnp.ndarray, gy0, gx0, ny: int, nx: int,
+                            order: int, xcfl: float, ycfl: float,
+                            bc: tuple[float, float, float, float],
+                            k: int = 1, tile_y: int = 128,
+                            interpret: bool = False) -> jnp.ndarray:
+    """k fused timesteps on a K-padded shard-local block (Pallas).
+
+    ``p`` is the local block with K = k·border of halo on every side
+    (neighbor data or BC fill — what ``dist/heat._assemble_padded``
+    produces); ``(gy0, gx0)`` are the global halo-grid coordinates of
+    ``p[0, 0]`` (traced values — ``axis_index`` products); ``(ny, nx)``
+    the global interior extents.  Returns the updated (H, W) block whose
+    rows/cols ``[K, K + local)`` are the valid k-step result — bitwise
+    equal to k applications of the sharded XLA path.
+
+    Row/lane padding added here for tiling is sound without masking: the
+    appended garbage sits ≥ K away from the valid region, and k sub-steps
+    spread garbage by exactly K — reaching, never entering, the valid
+    window (same argument as the single-device kernel's clamped edges).
+    """
+    b = BORDER_FOR_ORDER[order]
+    K = k * b
+    kpad = _ceil_to(K, SUBLANE)
+    assert tile_y % kpad == 0
+    H, W = p.shape
+    Hp = _ceil_to(H, tile_y)
+    Wp = _ceil_to(W, LANE)
+    if Hp != H or Wp != W:
+        p = jnp.pad(p, ((0, Hp - H), (0, Wp - W)))
+    nblk = Hp // tile_y
+    t_per_k = tile_y // kpad
+    kernel = _make_local_kernel(order, k, tile_y, kpad, ny, nx, b, bc,
+                                float(xcfl), float(ycfl), interpret)
+    offs = jnp.asarray([gy0, gx0], jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((kpad, Wp),
+                         lambda i, offs: (jnp.maximum(i * t_per_k - 1, 0),
+                                          0)),
+            pl.BlockSpec((tile_y, Wp), lambda i, offs: (i, 0)),
+            pl.BlockSpec((kpad, Wp),
+                         lambda i, offs: (jnp.minimum((i + 1) * t_per_k,
+                                                      Hp // kpad - 1), 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_y, Wp), lambda i, offs: (i, 0)),
+    )
+    # inside shard_map the output aval must carry the varying-across-mesh
+    # annotation; inherit it from the input block
+    try:
+        vma = jax.typeof(p).vma
+    except AttributeError:
+        vma = frozenset()
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((Hp, Wp), p.dtype, vma=vma),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(offs, p, p, p)
+    return out[:H, :W]

@@ -1,0 +1,48 @@
+#!/bin/bash
+# Full on-device measurement capture for a round: headline bench (f32 and
+# f64), the device-side sweep CSVs, and the Pallas tile sweep.  Run on the
+# real TPU (default axon platform) once the tunnel is healthy:
+#
+#   bash scripts/tpu_capture.sh [outdir]
+#
+# Every bench.py kernel runs in its own subprocess (bench.py does this
+# itself); the run_all sweeps share one process, so a kernel that kills
+# the device client aborts the remaining sweeps — run the bisect harness
+# (scripts/tpu_pipeline_bisect.py) first if kernels are suspect.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-bench_results}"
+mkdir -p "$OUT"
+
+echo "== preflight =="
+timeout 120 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+assert d.platform == 'tpu', f'not a TPU: {d}'
+(jnp.ones((8, 8)) * 2).block_until_ready()
+print('device:', d)
+" || { echo "preflight failed — tunnel down?"; exit 1; }
+
+echo "== headline bench (f32) =="
+python bench.py 2>"$OUT/bench_f32.stderr.log" | tee "$OUT/bench_f32.json"
+
+echo "== headline bench (f64, XLA kernel) =="
+python bench.py --dtype=f64 2>"$OUT/bench_f64.stderr.log" \
+    | tee "$OUT/bench_f64.json"
+
+echo "== device sweeps =="
+python -m cme213_tpu.bench.run_all --out "$OUT" --only \
+    data_bandwidth_vector_length,bandwidth_vs_avg_edges,heat_bandwidth,pallas_tile,heat_kernels,transfer_bandwidth,scan_bandwidth,spmv_suite
+
+echo "== f64 heat rows (reference's double 4th-order axis) =="
+JAX_ENABLE_X64=1 python - <<'EOF'
+from cme213_tpu.bench import sweeps
+import sys
+rows = sweeps.heat_sweep(sizes=(4000,), orders=(2, 4, 8), iters=100,
+                         dtype="f64")
+sweeps.write_csv(rows, sys.argv[1] if len(sys.argv) > 1
+                 else "bench_results/heat_bandwidth_f64.csv")
+print(f"f64 rows: {len(rows)}")
+EOF
+
+echo "capture complete: $OUT"

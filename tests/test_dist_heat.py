@@ -155,3 +155,48 @@ def test_communication_avoiding_fallback_thin_shards():
 
     _, _, k_used = prepare_distributed_heat(p, mesh, steps_per_exchange=2)
     assert k_used == 1
+
+
+@pytest.mark.parametrize("k", [1, 2])
+@pytest.mark.parametrize("mesh_kind", ["1d", "2d"])
+def test_pallas_local_kernel_matches_single_device(k, mesh_kind):
+    """Tuned Pallas pipeline kernel as the per-shard stencil (the hw5
+    pattern: the optimized hw2 kernel under the comm layer) — bitwise
+    against the single-device XLA solve."""
+    params = SimParams(nx=40, ny=48, order=8, iters=4 * k, bc_top=2.0,
+                       bc_left=0.5, bc_bottom=1.0, bc_right=3.0)
+    mesh = make_mesh_1d(4) if mesh_kind == "1d" else make_mesh_2d(2, 2)
+    ref = single_device_reference(params, 4 * k)
+    out = run_distributed_heat(params, mesh, steps_per_exchange=k,
+                               local_kernel="pallas")
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_pallas_local_kernel_uneven_shards():
+    params = SimParams(nx=30, ny=42, order=4, iters=4)
+    mesh = make_mesh_1d(4)  # 42 rows over 4 shards: ghost-padded
+    ref = single_device_reference(params, 4)
+    out = run_distributed_heat(params, mesh, local_kernel="pallas")
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_pallas_local_kernel_keeps_requested_k_with_async_params():
+    """synchronous=False params must not silently degrade the requested
+    communication-avoiding k under the Pallas local kernel."""
+    from cme213_tpu.dist import prepare_distributed_heat
+
+    params = SimParams(nx=40, ny=48, order=8, iters=8, synchronous=False)
+    mesh = make_mesh_1d(2)
+    _, _, k_used = prepare_distributed_heat(params, mesh,
+                                            steps_per_exchange=2,
+                                            local_kernel="pallas")
+    assert k_used == 2
+
+
+def test_unknown_local_kernel_rejected():
+    from cme213_tpu.dist import prepare_distributed_heat
+
+    params = SimParams(nx=40, ny=48, order=8, iters=8)
+    with pytest.raises(ValueError, match="local_kernel"):
+        prepare_distributed_heat(params, make_mesh_1d(2),
+                                 local_kernel="Pallas")
