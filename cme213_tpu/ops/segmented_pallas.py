@@ -78,10 +78,18 @@ def _make_kernel(rows: int, fused_multiply: bool = False,
             d *= 2
         # 2) segmented scan of row summaries along sublanes, carried on
         # full-width (rows, 128) arrays (each row = its summary broadcast
-        # across lanes) — lane-1 slices would carry offset layouts Mosaic
-        # sublane ops dislike; the redundant lanes are free on the VPU
-        sv = jnp.broadcast_to(v[:, _LANES - 1:], (rows, _LANES))
-        sf = jnp.broadcast_to(f[:, _LANES - 1:], (rows, _LANES))
+        # across lanes).  The summary is extracted with a masked lane
+        # reduce rather than a v[:, 127:] slice: single-lane slices carry
+        # Mosaic offset layouts that later sublane ops refuse to combine,
+        # while reduce + broadcast lower cleanly; the redundant lanes are
+        # free on the VPU.
+        last_lane = lane == _LANES - 1
+        sv = jnp.broadcast_to(
+            jnp.sum(jnp.where(last_lane, v, jnp.zeros_like(v)), axis=1,
+                    keepdims=True), (rows, _LANES))
+        sf = jnp.broadcast_to(
+            jnp.max(jnp.where(last_lane, f, jnp.zeros_like(f)), axis=1,
+                    keepdims=True), (rows, _LANES))
         d = 1
         while d < rows:
             pv = _roll(sv, d, 0, interpret)
