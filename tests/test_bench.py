@@ -1,0 +1,132 @@
+"""bench.py parent-process logic — the driver-facing artifact.
+
+These tests fake the per-kernel child processes so the aggregation,
+short-circuit, and fallback behavior (the parts that cost a whole round
+when wrong, cf. BENCH_r02) are pinned without a device.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+class FakeProc:
+    def __init__(self, stdout="", returncode=0, stderr=""):
+        self.stdout = stdout
+        self.returncode = returncode
+        self.stderr = stderr
+
+
+def _row(name, gbs, platform="tpu"):
+    return json.dumps({"kernel": name, "ok": True, "iters": 100,
+                       "platform": platform, "ms_per_iter": 1.0,
+                       "gbs": gbs, "gflops": 1.0})
+
+
+def test_best_kernel_selection(monkeypatch, capsys):
+    gbs = {"xla": 14.0, "xla-roll": 100.0, "xla-conv": 0.1,
+           "pipeline-k1": 300.0, "pipeline-k2": 500.0,
+           "pipeline-k4": 450.0, "pipeline-k8": 400.0}
+
+    def fake_run(cmd, **kwargs):
+        name = next(a.split("=", 1)[1] for a in cmd
+                    if a.startswith("--kernel="))
+        return FakeProc(stdout=_row(name, gbs[name]) + "\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "pipeline-k2" in out["metric"]
+    assert out["value"] == 500.0
+    assert out["vs_baseline"] == round(500.0 / bench.BASELINE_GBS, 3)
+    assert out["pct_hbm_peak"] == round(100 * 500.0 / bench.HBM_PEAK_GBS, 1)
+    assert len(out["kernels"]) == len(bench.KERNELS)
+
+
+def test_one_faulting_kernel_does_not_poison_others(monkeypatch, capsys):
+    """The BENCH_r02 failure mode: one kernel dies, the rest still report."""
+    def fake_run(cmd, **kwargs):
+        name = next(a.split("=", 1)[1] for a in cmd
+                    if a.startswith("--kernel="))
+        if name == "xla-conv":
+            return FakeProc(returncode=1, stderr="kernel fault")
+        return FakeProc(stdout=_row(name, 20.0) + "\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    rows = {r["kernel"]: r for r in out["kernels"]}
+    assert not rows["xla-conv"]["ok"]
+    assert all(rows[k]["ok"] for k in rows if k != "xla-conv")
+    assert out["value"] == 20.0
+
+
+def test_dead_device_short_circuits(monkeypatch, capsys):
+    """Two consecutive preflight failures skip the remaining kernels
+    instead of burning 90s+120s each."""
+    calls = []
+
+    def fake_run(cmd, **kwargs):
+        calls.append(cmd)
+        return FakeProc(returncode=bench._PREFLIGHT_EXIT)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    import time
+
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 0.0 and out["vs_baseline"] == 0.0
+    assert "DEVICE UNAVAILABLE" in out["metric"]
+    # 2 kernels probed (2 attempts each), the rest skipped without spawn
+    assert len(calls) == 4
+    skipped = [r for r in out["kernels"] if "skipped" in r.get("error", "")]
+    assert len(skipped) == len(bench.KERNELS) - 2
+
+
+def test_non_tpu_platform_skips_remaining_non_xla(monkeypatch, capsys):
+    spawned = []
+
+    def fake_run(cmd, **kwargs):
+        name = next(a.split("=", 1)[1] for a in cmd
+                    if a.startswith("--kernel="))
+        spawned.append(name)
+        if name == "xla":
+            return FakeProc(stdout=_row(name, 0.3, platform="cpu") + "\n")
+        return FakeProc(stdout=json.dumps(
+            {"kernel": name, "ok": False, "platform": "cpu",
+             "error": "skipped: not on TPU"}) + "\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    # only xla spawns a child once the platform is known to be CPU
+    assert spawned == ["xla"]
+    assert out["value"] == 0.3
+
+
+def test_f64_runs_xla_only(monkeypatch, capsys):
+    spawned = []
+
+    def fake_run(cmd, **kwargs):
+        name = next(a.split("=", 1)[1] for a in cmd
+                    if a.startswith("--kernel="))
+        spawned.append(name)
+        assert "--dtype=f64" in cmd
+        return FakeProc(stdout=_row(name, 25.0) + "\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--dtype=f64"])
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert spawned == ["xla"]
+    assert "f64" in out["metric"]
