@@ -30,9 +30,15 @@ echo "== headline bench (f64, XLA kernel) =="
 python bench.py --dtype=f64 2>"$OUT/bench_f64.stderr.log" \
     | tee "$OUT/bench_f64.json"
 
-echo "== device sweeps =="
-python -m cme213_tpu.bench.run_all --out "$OUT" --only \
-    data_bandwidth_vector_length,bandwidth_vs_avg_edges,heat_bandwidth,pallas_tile,heat_kernels,transfer_bandwidth,scan_bandwidth,spmv_suite
+echo "== device sweeps (one process each: a kernel that kills the device"
+echo "   client then costs one sweep, not the rest; riskiest last) =="
+for sweep in transfer_bandwidth data_bandwidth_vector_length \
+             bandwidth_vs_avg_edges scan_bandwidth spmv_suite \
+             dist_heat_scaling heat_bandwidth pallas_tile heat_kernels; do
+    echo "-- $sweep"
+    timeout 2700 python -m cme213_tpu.bench.run_all --out "$OUT" \
+        --only "$sweep" || echo "$sweep: FAILED (continuing)"
+done
 
 echo "== f64 heat rows (reference's double 4th-order axis) =="
 JAX_ENABLE_X64=1 python - <<'EOF'
