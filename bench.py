@@ -32,7 +32,7 @@ _PREFLIGHT_EXIT = 42
 
 # candidate kernel names; each runs in its own child process
 KERNELS = ("xla", "xla-roll", "xla-conv", "pipeline-k1", "pipeline-k2",
-           "pipeline-k4", "pipeline-k8")
+           "pipeline-k4", "pipeline-k8", "pipeline2d-k1", "pipeline2d-k8")
 _EXEC_CAP_S = 30.0
 _MAX_ITERS = 400
 
@@ -82,14 +82,22 @@ def _make_candidate(name: str, params, on_tpu: bool):
     if name == "xla-conv":
         return (lambda u, it: run_heat_conv(u, it, order, params.xcfl,
                                             params.ycfl), 1)
-    if name.startswith("pipeline-k"):
-        from cme213_tpu.ops.stencil_pipeline import pick_pipeline_tile
+    if name.startswith("pipeline-k") or name.startswith("pipeline2d-k"):
+        from cme213_tpu.ops.stencil_pipeline import (pick_pipeline_tile,
+                                                     run_heat_pipeline2d)
 
-        k = int(name.split("pipeline-k")[1])
+        k = int(name.split("-k")[1])
         # BENCH_TILE_Y is a target; round it to a valid multiple of the
         # halo quantum so an arbitrary override can't trip the tile assert
         target = int(os.environ.get("BENCH_TILE_Y", "256"))
         tile_y = pick_pipeline_tile(params.gy, k, order, target=target)
+        if name.startswith("pipeline2d-k"):
+            # same rounding policy as BENCH_TILE_Y: a valid quantum always
+            tile_x = max(int(os.environ.get("BENCH_TILE_X", "512"))
+                         // 128 * 128, 128)
+            return (lambda u, it: run_heat_pipeline2d(
+                u, it, order, params.xcfl, params.ycfl, params.bc, k=k,
+                tile_y=tile_y, tile_x=tile_x, interpret=not on_tpu), k)
         return (lambda u, it: run_heat_pipeline(
             u, it, order, params.xcfl, params.ycfl, params.bc, k=k,
             tile_y=tile_y, interpret=not on_tpu), k)

@@ -63,6 +63,43 @@ def _roll(u, shift: int, axis: int, interpret: bool):
 # see its definition below pick_pipeline_tile)
 
 
+def _apply_substeps(u, rows, cols, order: int, k: int, border: int,
+                    ny: int, nx: int,
+                    bc: tuple[float, float, float, float],
+                    xcfl: float, ycfl: float, interpret: bool):
+    """k stencil sub-steps + Dirichlet re-imposition on a halo band.
+
+    The ONE definition of the update both kernel factories share (1-D
+    full-width and column-tiled): same taps, same accumulation order,
+    same reference band order (rows first, then columns overwrite the
+    corners) — the bitwise-equality contract between all kernel forms
+    lives here.  ``rows``/``cols`` are global halo-grid coordinate grids;
+    conditions ``< border`` / ``>= border + n`` are the physical
+    Dirichlet bands.
+    """
+    b = BORDER_FOR_ORDER[order]
+    coeffs = STENCIL_COEFFS[order]
+    bc_top, bc_left, bc_bottom, bc_right = bc
+    dtype = u.dtype
+    for _ in range(k):
+        accx = jnp.zeros_like(u)
+        accy = jnp.zeros_like(u)
+        for kk, c in enumerate(coeffs):
+            c = jnp.asarray(c, dtype)
+            accx = accx + c * _roll(u, b - kk, 1, interpret)
+            accy = accy + c * _roll(u, b - kk, 0, interpret)
+        new = (u + jnp.asarray(xcfl, dtype) * accx
+               + jnp.asarray(ycfl, dtype) * accy)
+        new = jnp.where(rows < border, jnp.asarray(bc_bottom, dtype), new)
+        new = jnp.where(rows >= border + ny,
+                        jnp.asarray(bc_top, dtype), new)
+        new = jnp.where(cols < border, jnp.asarray(bc_left, dtype), new)
+        new = jnp.where(cols >= border + nx,
+                        jnp.asarray(bc_right, dtype), new)
+        u = new
+    return u
+
+
 @partial(jax.jit,
          static_argnames=("order", "iters", "k", "xcfl", "ycfl", "bc",
                           "tile_y", "interpret"),
@@ -151,6 +188,133 @@ def pick_pipeline_tile(gy: int, k: int, order: int,
     return t
 
 
+def _make_tiled_kernel(order: int, k: int, tile_y: int, tile_x: int,
+                       kpad: int, ny: int, nx: int, border: int,
+                       bc: tuple[float, float, float, float],
+                       xcfl: float, ycfl: float, interpret: bool):
+    """Column-tiled variant: output tiles are (tile_y, tile_x) and the halo
+    arrives through a 3×3 ref layout — (kpad)-row bands above/below,
+    128-lane bands left/right, and the four corners (the k-step dependency
+    cone is an L1 ball, so diagonal data IS needed for k ≥ 2).  All
+    concatenations are 8/128-aligned; x-roll wrap lands in the 128-lane
+    side margins (K ≤ 128 asserted by the caller)."""
+
+    def kernel(offs, tl, t, tr, l, m, r, bl, bo, br, out_ref):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        band = jnp.concatenate([
+            jnp.concatenate([tl[:], t[:], tr[:]], axis=1),
+            jnp.concatenate([l[:], m[:], r[:]], axis=1),
+            jnp.concatenate([bl[:], bo[:], br[:]], axis=1),
+        ], axis=0)
+        H, W = band.shape
+        rows = (jax.lax.broadcasted_iota(jnp.int32, (H, W), 0)
+                + i * tile_y - kpad + offs[0])
+        cols = (jax.lax.broadcasted_iota(jnp.int32, (H, W), 1)
+                + j * tile_x - LANE + offs[1])
+        u = _apply_substeps(band, rows, cols, order, k, border, ny, nx, bc,
+                            xcfl, ycfl, interpret)
+        out = _roll(u, -kpad, 0, interpret)[:tile_y, :]
+        out_ref[:] = _roll(out, -LANE, 1, interpret)[:, :tile_x]
+
+    return kernel
+
+
+@partial(jax.jit,
+         static_argnames=("order", "iters", "k", "xcfl", "ycfl", "bc",
+                          "tile_y", "tile_x", "interpret"),
+         donate_argnums=(0,))
+def run_heat_pipeline2d(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
+                        bc: tuple[float, float, float, float], k: int = 1,
+                        tile_y: int = 256, tile_x: int = 512,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Column-tiled form of ``run_heat_pipeline`` (2-D grid of
+    (tile_y, tile_x) output tiles).  Semantically identical — bitwise
+    equal to ``run_heat`` on the interior; exists because full-width
+    bands at large widths are the suspected trigger of the remote-compile
+    crash, and because smaller output tiles pipeline at finer grain.
+    ``tile_x`` must divide by 128; ``k·border`` must be ≤ 128 (the x-halo
+    the side refs carry).
+    """
+    b = BORDER_FOR_ORDER[order]
+    K = k * b
+    kpad = _ceil_to(K, SUBLANE)
+    gy, gx = u.shape
+    assert iters % k == 0, "iters must divide by k"
+    assert tile_y % kpad == 0, "tile_y must divide by ceil8(k*border)"
+    assert tile_x % LANE == 0, "tile_x must divide by 128"
+    assert K <= LANE, "k*border exceeds the 128-lane side halo"
+    bc_top, bc_left, bc_bottom, bc_right = bc
+
+    GX = _ceil_to(gx, tile_x)
+    GY = _ceil_to(gy, tile_y)
+    padded = u
+    if GX != gx:
+        padded = jnp.pad(padded, ((0, 0), (0, GX - gx)),
+                         constant_values=bc_right)
+    if GY != gy:
+        padded = jnp.pad(padded, ((0, GY - gy), (0, 0)),
+                         constant_values=bc_top)
+
+    ty = tile_y // kpad
+    tx = tile_x // LANE
+    GYk = GY // kpad
+    GX128 = GX // LANE
+    kernel = _make_tiled_kernel(order, k, tile_y, tile_x, kpad, gy - 2 * b,
+                                gx - 2 * b, b, bc, float(xcfl),
+                                float(ycfl), interpret)
+    offs = jnp.zeros((2,), jnp.int32)
+
+    def iT(i, j, offs):
+        return jnp.maximum(i * ty - 1, 0)
+
+    def iB(i, j, offs):
+        return jnp.minimum((i + 1) * ty, GYk - 1)
+
+    def jL(i, j, offs):
+        return jnp.maximum(j * tx - 1, 0)
+
+    def jR(i, j, offs):
+        return jnp.minimum((j + 1) * tx, GX128 - 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(GY // tile_y, GX // tile_x),
+        in_specs=[
+            pl.BlockSpec((kpad, LANE),
+                         lambda i, j, offs: (iT(i, j, offs), jL(i, j, offs))),
+            pl.BlockSpec((kpad, tile_x),
+                         lambda i, j, offs: (iT(i, j, offs), j)),
+            pl.BlockSpec((kpad, LANE),
+                         lambda i, j, offs: (iT(i, j, offs), jR(i, j, offs))),
+            pl.BlockSpec((tile_y, LANE),
+                         lambda i, j, offs: (i, jL(i, j, offs))),
+            pl.BlockSpec((tile_y, tile_x), lambda i, j, offs: (i, j)),
+            pl.BlockSpec((tile_y, LANE),
+                         lambda i, j, offs: (i, jR(i, j, offs))),
+            pl.BlockSpec((kpad, LANE),
+                         lambda i, j, offs: (iB(i, j, offs), jL(i, j, offs))),
+            pl.BlockSpec((kpad, tile_x),
+                         lambda i, j, offs: (iB(i, j, offs), j)),
+            pl.BlockSpec((kpad, LANE),
+                         lambda i, j, offs: (iB(i, j, offs), jR(i, j, offs))),
+        ],
+        out_specs=pl.BlockSpec((tile_y, tile_x), lambda i, j, offs: (i, j)),
+    )
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((GY, GX), u.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+
+    def body(_, p):
+        return call(offs, p, p, p, p, p, p, p, p, p)
+
+    padded = lax.fori_loop(0, iters // k, body, padded)
+    return padded[:gy, :gx]
+
+
 def _make_local_kernel(order: int, k: int, tile_y: int, kpad: int,
                        ny: int, nx: int, border: int,
                        bc: tuple[float, float, float, float],
@@ -160,39 +324,19 @@ def _make_local_kernel(order: int, k: int, tile_y: int, kpad: int,
     coords of array element [0, 0]).  For interior shards no mask ever
     fires and the kernel is pure stencil; boundary shards re-impose the
     same Dirichlet bands the single-device kernel does."""
-    b = BORDER_FOR_ORDER[order]
-    coeffs = STENCIL_COEFFS[order]
-    bc_top, bc_left, bc_bottom, bc_right = bc
 
     def kernel(offs, top_ref, mid_ref, bot_ref, out_ref):
         i = pl.program_id(0)
         band = jnp.concatenate([top_ref[:], mid_ref[:], bot_ref[:]], axis=0)
         H, W = band.shape
-        dtype = band.dtype
+        # global-coordinate grids; conditions < b / >= b + n are the same
+        # physical-Dirichlet-band tests the sharded XLA path uses
+        # (dist/heat._multistep_local_step)
         rows = (jax.lax.broadcasted_iota(jnp.int32, (H, W), 0)
                 + i * tile_y - kpad + offs[0])
         cols = jax.lax.broadcasted_iota(jnp.int32, (H, W), 1) + offs[1]
-        u = band
-        for _ in range(k):
-            accx = jnp.zeros_like(u)
-            accy = jnp.zeros_like(u)
-            for kk, c in enumerate(coeffs):
-                c = jnp.asarray(c, dtype)
-                accx = accx + c * _roll(u, b - kk, 1, interpret)
-                accy = accy + c * _roll(u, b - kk, 0, interpret)
-            new = (u + jnp.asarray(xcfl, dtype) * accx
-                   + jnp.asarray(ycfl, dtype) * accy)
-            # same global-coordinate conditions as the sharded XLA path
-            # (dist/heat._multistep_local_step): halo-grid row/col < b or
-            # >= b + n  =>  physical Dirichlet band
-            new = jnp.where(rows < border, jnp.asarray(bc_bottom, dtype),
-                            new)
-            new = jnp.where(rows >= border + ny,
-                            jnp.asarray(bc_top, dtype), new)
-            new = jnp.where(cols < border, jnp.asarray(bc_left, dtype), new)
-            new = jnp.where(cols >= border + nx,
-                            jnp.asarray(bc_right, dtype), new)
-            u = new
+        u = _apply_substeps(band, rows, cols, order, k, border, ny, nx, bc,
+                            xcfl, ycfl, interpret)
         out_ref[:] = _roll(u, -kpad, 0, interpret)[:tile_y, :]
 
     return kernel

@@ -18,66 +18,79 @@ import subprocess
 import sys
 
 DEFAULT_CELLS = [
+    # (nx, ny, tile_y, k, tile_x)  — tile_x 0 = full-width 1-D pipeline
     # vary total width at fixed tile (W = ceil128(nx+8))
-    (2040, 2040, 256, 1),   # W=2048
-    (2552, 2552, 256, 1),   # W=2560
-    (3064, 3064, 256, 1),   # W=3072
-    (3576, 3576, 256, 1),   # W=3584
-    (4000, 4000, 256, 1),   # W=4096  <- known bad
-    (4504, 4504, 256, 1),   # W=4608  past the 4096 boundary
+    (2040, 2040, 256, 1, 0),   # W=2048
+    (2552, 2552, 256, 1, 0),   # W=2560
+    (3064, 3064, 256, 1, 0),   # W=3072
+    (3576, 3576, 256, 1, 0),   # W=3584
+    (4000, 4000, 256, 1, 0),   # W=4096  <- known bad
+    (4504, 4504, 256, 1, 0),   # W=4608  past the 4096 boundary
     # 4000-wide, vary rows (is it rows x cols area?)
-    (4000, 1016, 256, 1),
-    (4000, 2040, 256, 1),
+    (4000, 1016, 256, 1, 0),
+    (4000, 2040, 256, 1, 0),
     # 4000-wide, vary tile
-    (4000, 4000, 64, 1),
-    (4000, 4000, 128, 1),
+    (4000, 4000, 64, 1, 0),
+    (4000, 4000, 128, 1, 0),
     # temporal blocking at the bad width
-    (4000, 4000, 256, 8),
+    (4000, 4000, 256, 8, 0),
+    # column-tiled variant at the bad width
+    (4000, 4000, 256, 1, 512),
+    (4000, 4000, 256, 8, 512),
+    (4000, 4000, 256, 1, 1024),
 ]
 
 _CHILD = "--child"
 
 
-def run_cell(nx: int, ny: int, tile: int, k: int) -> None:
+def run_cell(nx: int, ny: int, tile: int, k: int, tile_x: int) -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from cme213_tpu.config import SimParams
     from cme213_tpu.grid import make_initial_grid
-    from cme213_tpu.ops.stencil_pipeline import run_heat_pipeline
+    from cme213_tpu.ops.stencil_pipeline import (run_heat_pipeline,
+                                                 run_heat_pipeline2d)
 
     p = SimParams(nx=nx, ny=ny, order=8, iters=k)
     u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
-    out = jax.block_until_ready(run_heat_pipeline(
-        jax.device_put(u0), k, 8, p.xcfl, p.ycfl, p.bc, k=k, tile_y=tile,
-        interpret=False))
+    if tile_x:
+        out = run_heat_pipeline2d(jax.device_put(u0), k, 8, p.xcfl, p.ycfl,
+                                  p.bc, k=k, tile_y=tile, tile_x=tile_x,
+                                  interpret=False)
+    else:
+        out = run_heat_pipeline(jax.device_put(u0), k, 8, p.xcfl, p.ycfl,
+                                p.bc, k=k, tile_y=tile, interpret=False)
+    jax.block_until_ready(out)
     print(json.dumps({"ok": True, "checksum": float(np.asarray(out).sum())}))
 
 
 def main() -> int:
     if _CHILD in sys.argv:
         i = sys.argv.index(_CHILD)
-        nx, ny, tile, k = (int(v) for v in sys.argv[i + 1].split(","))
-        run_cell(nx, ny, tile, k)
+        nx, ny, tile, k, tile_x = (int(v) for v in
+                                   sys.argv[i + 1].split(","))
+        run_cell(nx, ny, tile, k, tile_x)
         return 0
 
     cells = DEFAULT_CELLS
     for a in sys.argv[1:]:
         if a.startswith("--cells="):
-            cells = [tuple(int(v) for v in c.split(","))
+            # 4-tuples (the pre-tile_x format) default tile_x to 0 = 1-D
+            cells = [(tuple(int(v) for v in c.split(",")) + (0,))[:5]
                      for c in a.split("=", 1)[1].split(";") if c]
-    for nx, ny, tile, k in cells:
+    for nx, ny, tile, k, tile_x in cells:
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), _CHILD,
-                 f"{nx},{ny},{tile},{k}"],
+                 f"{nx},{ny},{tile},{k},{tile_x}"],
                 timeout=600, capture_output=True, text=True)
             ok = proc.returncode == 0 and '"ok": true' in proc.stdout
             tail = "" if ok else (proc.stderr.strip().splitlines() or [""])[-1][:160]
         except subprocess.TimeoutExpired:
             ok, tail = False, "timeout"
-        print(f"nx={nx} ny={ny} tile={tile} k={k}: "
+        print(f"nx={nx} ny={ny} tile={tile} k={k} tile_x={tile_x}: "
               f"{'OK' if ok else 'FAIL ' + tail}", flush=True)
     return 0
 

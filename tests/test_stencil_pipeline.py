@@ -88,3 +88,30 @@ def test_roll_formulation_bitwise(order):
     out = np.asarray(run_heat_roll(jnp.array(u0), 6, order, p.xcfl,
                                    p.ycfl, p.bc))
     np.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.parametrize("k,tile_y,tile_x", [(1, 16, 128), (2, 8, 128),
+                                             (4, 16, 256)])
+def test_pipeline2d_bitwise(k, tile_y, tile_x):
+    from cme213_tpu.ops.stencil_pipeline import run_heat_pipeline2d
+
+    p = SimParams(nx=300, ny=120, order=8, iters=8 * k, bc_top=1.5,
+                  bc_left=0.5, bc_bottom=2.0, bc_right=0.25)
+    u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+    ref = np.asarray(run_heat(jnp.array(u0), 8 * k, 8, p.xcfl, p.ycfl))
+    out = np.asarray(run_heat_pipeline2d(
+        jnp.array(u0), 8 * k, 8, p.xcfl, p.ycfl, p.bc, k=k, tile_y=tile_y,
+        tile_x=tile_x, interpret=INTERPRET))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_pipeline2d_single_tile_and_awkward():
+    from cme213_tpu.ops.stencil_pipeline import run_heat_pipeline2d
+
+    p = SimParams(nx=77, ny=33, order=2, iters=6)
+    u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+    ref = np.asarray(run_heat(jnp.array(u0), 6, 2, p.xcfl, p.ycfl))
+    out = np.asarray(run_heat_pipeline2d(
+        jnp.array(u0), 6, 2, p.xcfl, p.ycfl, p.bc, k=2, tile_y=8,
+        tile_x=128, interpret=INTERPRET))
+    np.testing.assert_array_equal(out, ref)
