@@ -259,6 +259,8 @@ def heat_kernel_sweep(size: int = 4000, order: int = 8,
                                                   p.ycfl, tile_y=t,
                                                   interpret=interpret)),
     }
+    from ..ops.stencil_pipeline import run_heat_pipeline2d
+
     for k in (1,) + tuple(ks):
         if iters % k == 0:
             ty = pick_pipeline_tile(p.gy, k, order)
@@ -266,6 +268,10 @@ def heat_kernel_sweep(size: int = 4000, order: int = 8,
                 iters, lambda u, k=k, ty=ty: run_heat_pipeline(
                     u, iters, order, p.xcfl, p.ycfl, p.bc, k=k, tile_y=ty,
                     interpret=interpret))
+            cands[f"pipeline2d-k{k}"] = (
+                iters, lambda u, k=k, ty=ty: run_heat_pipeline2d(
+                    u, iters, order, p.xcfl, p.ycfl, p.bc, k=k, tile_y=ty,
+                    tile_x=512, interpret=interpret))
     for k in ks:
         if iters % k == 0:
             cands[f"pallas-k{k}"] = (
