@@ -46,24 +46,10 @@ def _apply_platform_env() -> None:
 
 
 def _preflight(seconds: float = 90.0) -> bool:
-    """Run a trivial device op on a watchdog thread.  A wedged TPU tunnel
-    hangs inside PJRT client creation where Python signals can't fire, so
-    the check runs in a daemon thread and the caller exits if it never
-    returns."""
-    import threading
+    """Device-reachability watchdog (see core/platform.device_preflight)."""
+    from cme213_tpu.core.platform import device_preflight
 
-    done = threading.Event()
-
-    def probe():
-        _apply_platform_env()
-        import jax
-        import jax.numpy as jnp
-
-        (jnp.ones((8, 8)) * 2).block_until_ready()
-        done.set()
-
-    threading.Thread(target=probe, daemon=True).start()
-    return done.wait(seconds)
+    return device_preflight(seconds)
 
 
 def _make_candidate(name: str, params, on_tpu: bool):

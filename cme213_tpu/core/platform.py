@@ -40,6 +40,32 @@ def apply_platform_env() -> None:
         jax.config.update("jax_platforms", plat)
 
 
+def device_preflight(seconds: float = 90.0) -> bool:
+    """True iff a trivial device op completes within ``seconds``.
+
+    A wedged TPU tunnel hangs inside PJRT client creation, where Python
+    signal handlers can't fire — so the probe runs on a daemon thread and
+    the caller just times out.  The shared failure-detection primitive
+    behind ``bench.py``'s per-kernel preflight and the probe scripts
+    (the reference's fail-fast `check_launch`, aimed at a failure mode
+    GPUs didn't have).
+    """
+    import threading
+
+    done = threading.Event()
+
+    def probe():
+        apply_platform_env()
+        import jax
+        import jax.numpy as jnp
+
+        (jnp.ones((8, 8)) * 2).block_until_ready()
+        done.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    return done.wait(seconds)
+
+
 def force_cpu_devices(n_devices: int) -> None:
     """Pin JAX to the CPU platform with at least ``n_devices`` host devices.
 

@@ -131,3 +131,24 @@ def test_f64_runs_xla_only(monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out.strip())
     assert spawned == ["xla"]
     assert "f64" in out["metric"]
+
+
+def test_device_preflight_cpu():
+    from cme213_tpu.core.platform import device_preflight
+
+    assert device_preflight(60.0)  # CPU backend always reachable
+
+
+def test_bisect_cell_parsing():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bisect", Path(__file__).resolve().parent.parent
+        / "scripts" / "tpu_pipeline_bisect.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # 5-field cells pass through; legacy 4-field cells get tile_x=0
+    cells = [(tuple(int(v) for v in c.split(",")) + (0,))[:5]
+             for c in "4000,4000,256,1;512,512,64,2,128".split(";")]
+    assert cells == [(4000, 4000, 256, 1, 0), (512, 512, 64, 2, 128)]
+    assert all(len(c) == 5 for c in mod.DEFAULT_CELLS)
