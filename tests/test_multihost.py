@@ -156,23 +156,31 @@ _LAUNCH_WORKER = textwrap.dedent("""
 """)
 
 
-def test_launcher_two_ranks(tmp_path):
-    """The mpirun-analog launcher: 2 ranks x 2 fake devices, rank-tagged
-    output, zero exit."""
+
+def _run_launcher(tmp_path, worker_src: str, devices_per_proc: int | None,
+                  np_procs: int = 2) -> int:
+    """Shared launcher-test boilerplate: write the worker, clear the
+    JAX_PLATFORMS override (workers pick their own platform), launch."""
     import os
 
     from cme213_tpu.dist.launch import launch
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = tmp_path / "w.py"
-    script.write_text(_LAUNCH_WORKER.format(repo=repo))
+    script = tmp_path / "worker.py"
+    script.write_text(worker_src.format(repo=repo))
     env_backup = os.environ.pop("JAX_PLATFORMS", None)
     try:
-        rc = launch(2, [sys.executable, str(script)], devices_per_proc=2)
+        return launch(np_procs, [sys.executable, str(script)],
+                      devices_per_proc=devices_per_proc)
     finally:
         if env_backup is not None:
             os.environ["JAX_PLATFORMS"] = env_backup
-    assert rc == 0
+
+
+def test_launcher_two_ranks(tmp_path):
+    """The mpirun-analog launcher: 2 ranks x 2 fake devices, rank-tagged
+    output, zero exit."""
+    assert _run_launcher(tmp_path, _LAUNCH_WORKER, devices_per_proc=2) == 0
 
 
 def test_launcher_fail_fast(tmp_path):
@@ -229,20 +237,7 @@ def test_launcher_distributed_scan_two_ranks(tmp_path):
     """The long-context path (sharded segmented scan, ring carries) across
     two REAL processes: collectives ride the cross-process runtime, each
     rank checks its addressable shards against the host golden."""
-    import os
-
-    from cme213_tpu.dist.launch import launch
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = tmp_path / "scan_worker.py"
-    script.write_text(_SCAN_WORKER.format(repo=repo))
-    env_backup = os.environ.pop("JAX_PLATFORMS", None)
-    try:
-        rc = launch(2, [sys.executable, str(script)], devices_per_proc=4)
-    finally:
-        if env_backup is not None:
-            os.environ["JAX_PLATFORMS"] = env_backup
-    assert rc == 0
+    assert _run_launcher(tmp_path, _SCAN_WORKER, devices_per_proc=4) == 0
 
 
 _HEAT_WORKER = textwrap.dedent("""
@@ -283,17 +278,4 @@ def test_launcher_distributed_heat_two_ranks(tmp_path):
     """The hw5 backbone — ppermute halo exchange + sharded stencil — across
     two REAL processes, shard-checked bitwise against the single-device
     solve (the reference's N-rank-vs-1-rank methodology, for real)."""
-    import os
-
-    from cme213_tpu.dist.launch import launch
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = tmp_path / "heat_worker.py"
-    script.write_text(_HEAT_WORKER.format(repo=repo))
-    env_backup = os.environ.pop("JAX_PLATFORMS", None)
-    try:
-        rc = launch(2, [sys.executable, str(script)], devices_per_proc=4)
-    finally:
-        if env_backup is not None:
-            os.environ["JAX_PLATFORMS"] = env_backup
-    assert rc == 0
+    assert _run_launcher(tmp_path, _HEAT_WORKER, devices_per_proc=4) == 0
