@@ -1,0 +1,37 @@
+#!/bin/bash
+# Watch for tunnel recovery, then run the full round-3 device sequence
+# unattended: compile bisect -> headline bench -> sweep capture.
+# Logs to /tmp/tpu_autocapture.log; touches /tmp/tpu_capture_done when
+# finished so an operator (or the session) can pick up tuning from there.
+INTERVAL="${1:-60}"
+DEADLINE="${2:-28800}"
+cd "$(dirname "$0")/.."
+start=$(date +%s)
+log=/tmp/tpu_autocapture.log
+while true; do
+  now=$(date +%s)
+  if [ $((now - start)) -gt "$DEADLINE" ]; then
+    echo "$(date -Is) GAVE UP" >> "$log"
+    exit 1
+  fi
+  if timeout 90 python -c "
+from cme213_tpu.core.platform import device_preflight
+import jax, sys
+sys.exit(0 if device_preflight(75) and jax.devices()[0].platform == 'tpu'
+         else 1)" >/dev/null 2>&1; then
+    echo "$(date -Is) TPU UP — starting capture" >> "$log"
+    break
+  fi
+  sleep "$INTERVAL"
+done
+
+{
+  echo "== bisect =="
+  timeout 3600 python scripts/tpu_pipeline_bisect.py
+  echo "== bench f32 =="
+  timeout 5400 python bench.py 2>&1
+  echo "== full capture =="
+  timeout 14000 bash scripts/tpu_capture.sh bench_results
+  echo "$(date -Is) capture complete"
+} >> "$log" 2>&1
+touch /tmp/tpu_capture_done
