@@ -152,3 +152,16 @@ def test_bisect_cell_parsing():
              for c in "4000,4000,256,1;512,512,64,2,128".split(";")]
     assert cells == [(4000, 4000, 256, 1, 0), (512, 512, 64, 2, 128)]
     assert all(len(c) == 5 for c in mod.DEFAULT_CELLS)
+
+
+def test_pipeline_candidate_tile_ladder():
+    """Pipeline children try descending tile sizes so one bad tile can't
+    zero out the kernel's row."""
+    from cme213_tpu.config import SimParams
+
+    params = SimParams(nx=4000, ny=4000, order=8, iters=8)
+    variants = bench._pipeline_candidates("pipeline-k8", params, 8, True)
+    labels = [l for l, _ in variants]
+    assert labels == ["tile_y=256", "tile_y=128", "tile_y=64"]
+    variants2d = bench._pipeline_candidates("pipeline2d-k1", params, 1, True)
+    assert all("tile_x=512" in l for l, _ in variants2d)
