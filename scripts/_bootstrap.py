@@ -1,0 +1,13 @@
+"""Make ``cme213_tpu`` importable from ``python scripts/<tool>.py``.
+
+Running a file inside scripts/ puts scripts/ (not the repo root) at
+``sys.path[0]``; importing this module from a sibling script prepends the
+repo root so the package resolves without an installed distribution or a
+PYTHONPATH export.
+"""
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

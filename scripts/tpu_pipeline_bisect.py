@@ -9,8 +9,9 @@ so the failing dimension (lane width vs rows vs tile) is identifiable.
 
 Usage: python scripts/tpu_pipeline_bisect.py [--cells "nx,ny,tile,k;..."]
 """
-
 from __future__ import annotations
+
+import _bootstrap  # noqa: F401  — repo-root sys.path fix
 
 import json
 import os

@@ -1,3 +1,4 @@
+import _bootstrap  # noqa: F401  — repo-root sys.path fix
 import sys, time
 import jax, jax.numpy as jnp, numpy as np
 from cme213_tpu.config import SimParams

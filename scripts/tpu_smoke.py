@@ -3,6 +3,7 @@
 Small shapes: fast compiles, exact or tolerance checks vs the XLA paths.
 Exit 0 = all kernels lower under Mosaic and agree with the reference paths.
 """
+import _bootstrap  # noqa: F401  — repo-root sys.path fix
 import sys
 
 import jax

@@ -3,6 +3,7 @@
 Correctness at small size vs the XLA path, then timing at 4000^2 over
 tile/k choices.  Dev tool, not part of the package.
 """
+import _bootstrap  # noqa: F401  — repo-root sys.path fix
 import sys
 import time
 

@@ -1,5 +1,6 @@
 """Time one heat-kernel config at 4000^2 order 8 on the TPU: 
 usage: tpu_time_one.py {xla | pallas TILE | multi K TILE} [iters]"""
+import _bootstrap  # noqa: F401  — repo-root sys.path fix
 import sys, time
 import jax, jax.numpy as jnp, numpy as np
 from cme213_tpu.config import SimParams
