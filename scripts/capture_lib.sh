@@ -1,0 +1,21 @@
+# Shared definitions for the device-capture scripts (sourced by
+# tpu_capture.sh and tpu_autocapture.sh) — one home for the sweep list,
+# the device-failure signatures, and the bench-result gate.
+
+# stderr signatures of a dead/dropped tunnel (vs a sticky kernel/compile
+# bug): such failures are retried on the next capture attempt
+DEVICE_ERR='UNAVAILABLE|unreachable|DEADLINE|preflight|device hang'
+
+SWEEPS="transfer_bandwidth data_bandwidth_vector_length \
+bandwidth_vs_avg_edges scan_bandwidth spmv_suite \
+dist_heat_scaling heat_bandwidth pallas_tile heat_kernels"
+
+bench_ok() {  # $1 = bench json path: holds a real (non-zero) number?
+  [ -s "$1" ] && grep -q '"unit": "GB/s"' "$1" \
+    && ! grep -q 'DEVICE UNAVAILABLE' "$1"
+}
+
+sweep_attempted() {  # $1 = outdir, $2 = sweep: captured, or sticky-failed?
+  [ -s "$1/$2.csv" ] && return 0
+  [ -s "$1/$2.failed" ] && ! grep -qE "$DEVICE_ERR" "$1/$2.failed"
+}

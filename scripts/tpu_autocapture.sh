@@ -1,37 +1,77 @@
 #!/bin/bash
-# Watch for tunnel recovery, then run the full round-3 device sequence
-# unattended: compile bisect -> headline bench -> sweep capture.
-# Logs to /tmp/tpu_autocapture.log; touches /tmp/tpu_capture_done when
-# finished so an operator (or the session) can pick up tuning from there.
+# Unattended device-capture loop for a round: wait for the tunnel, run
+# compile bisect -> headline bench -> sweep capture, and — because the
+# tunnel drops mid-sequence (round-3: first child preflight died after the
+# watcher's own preflight passed) — RETRY the whole sequence until the
+# headline bench lands a real number AND the sweep capture finishes,
+# instead of giving up after one shot.
+#
+#   bash scripts/tpu_autocapture.sh [poll_interval_s] [deadline_s]
+#
+# Logs to /tmp/tpu_autocapture.log; touches /tmp/tpu_capture_done on
+# success so an operator (or the session) can pick up tuning from there.
 INTERVAL="${1:-60}"
 DEADLINE="${2:-28800}"
 cd "$(dirname "$0")/.."
+. scripts/capture_lib.sh
 start=$(date +%s)
 log=/tmp/tpu_autocapture.log
+bisected=0
+
+up() {
+  timeout 90 python -c "
+from cme213_tpu.core.platform import device_preflight
+import jax, sys
+sys.exit(0 if device_preflight(75) and jax.devices()[0].platform == 'tpu'
+         else 1)" >/dev/null 2>&1
+}
+
 while true; do
   now=$(date +%s)
   if [ $((now - start)) -gt "$DEADLINE" ]; then
     echo "$(date -Is) GAVE UP" >> "$log"
     exit 1
   fi
-  if timeout 90 python -c "
-from cme213_tpu.core.platform import device_preflight
-import jax, sys
-sys.exit(0 if device_preflight(75) and jax.devices()[0].platform == 'tpu'
-         else 1)" >/dev/null 2>&1; then
-    echo "$(date -Is) TPU UP — starting capture" >> "$log"
-    break
+  if ! up; then
+    sleep "$INTERVAL"
+    continue
+  fi
+  echo "$(date -Is) TPU UP — starting capture attempt" >> "$log"
+  if [ "$bisected" = 0 ]; then
+    echo "== bisect ==" >> "$log"
+    timeout 3600 python scripts/tpu_pipeline_bisect.py \
+      > /tmp/tpu_bisect_last.txt 2>&1
+    cat /tmp/tpu_bisect_last.txt >> "$log"
+    # the matrix is evidence only if no row failed for a DEVICE reason (a
+    # drop mid-matrix leaves spurious FAIL rows); sticky compile failures
+    # are exactly what the bisect is for and do not force a re-run
+    if grep -qE ": (OK|FAIL)" /tmp/tpu_bisect_last.txt \
+       && ! grep -E ": FAIL" /tmp/tpu_bisect_last.txt \
+            | grep -qE "$DEVICE_ERR"; then
+      bisected=1
+    fi
+  fi
+  echo "== bench f32 ==" >> "$log"
+  timeout 5400 python bench.py \
+    > /tmp/tpu_bench_last.json 2>> "$log"
+  cat /tmp/tpu_bench_last.json >> "$log"
+  # proceed to the expensive sweep capture only if the bench recorded a
+  # real kernel number this attempt; otherwise go back to waiting
+  if bench_ok /tmp/tpu_bench_last.json; then
+    mkdir -p bench_results
+    # hand the gate run's result to tpu_capture.sh so the scarce f32
+    # headline bench isn't repeated inside the capture
+    cp /tmp/tpu_bench_last.json bench_results/bench_f32.json
+    echo "== full capture ==" >> "$log"
+    if SKIP_F32=1 timeout 14000 bash scripts/tpu_capture.sh bench_results \
+        >> "$log" 2>&1; then
+      echo "$(date -Is) capture complete" >> "$log"
+      touch /tmp/tpu_capture_done
+      exit 0
+    fi
+    echo "$(date -Is) capture incomplete — re-waiting" >> "$log"
+  else
+    echo "$(date -Is) bench had no usable number — re-waiting" >> "$log"
   fi
   sleep "$INTERVAL"
 done
-
-{
-  echo "== bisect =="
-  timeout 3600 python scripts/tpu_pipeline_bisect.py
-  echo "== bench f32 =="
-  timeout 5400 python bench.py 2>&1
-  echo "== full capture =="
-  timeout 14000 bash scripts/tpu_capture.sh bench_results
-  echo "$(date -Is) capture complete"
-} >> "$log" 2>&1
-touch /tmp/tpu_capture_done

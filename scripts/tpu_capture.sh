@@ -5,12 +5,16 @@
 #
 #   bash scripts/tpu_capture.sh [outdir]
 #
-# Every bench.py kernel runs in its own subprocess (bench.py does this
-# itself); the run_all sweeps share one process, so a kernel that kills
-# the device client aborts the remaining sweeps — run the bisect harness
-# (scripts/tpu_pipeline_bisect.py) first if kernels are suspect.
+# Resumable: a sweep whose CSV is already in outdir is skipped, and a sweep
+# that failed for a non-device reason (recorded in <sweep>.failed) is not
+# retried — so the autocapture watcher can re-invoke this script across
+# tunnel drops and it only re-runs what a drop actually cost.  SKIP_F32=1
+# skips the f32 headline bench (the watcher gates on its own run and
+# copies it in).  Exit 0 = both headline benches hold real numbers and
+# every sweep has a CSV or a non-device failure record.
 set -u
 cd "$(dirname "$0")/.."
+. scripts/capture_lib.sh
 OUT="${1:-bench_results}"
 mkdir -p "$OUT"
 
@@ -23,32 +27,75 @@ assert d.platform == 'tpu', f'not a TPU: {d}'
 print('device:', d)
 " || { echo "preflight failed — tunnel down?"; exit 1; }
 
-echo "== headline bench (f32) =="
-python bench.py 2>"$OUT/bench_f32.stderr.log" | tee "$OUT/bench_f32.json"
+if [ "${SKIP_F32:-0}" = 1 ] && bench_ok "$OUT/bench_f32.json"; then
+  echo "== headline bench (f32): using existing $OUT/bench_f32.json =="
+else
+  echo "== headline bench (f32) =="
+  python bench.py 2>"$OUT/bench_f32.stderr.log" | tee "$OUT/bench_f32.json"
+fi
 
-echo "== headline bench (f64, XLA kernel) =="
-python bench.py --dtype=f64 2>"$OUT/bench_f64.stderr.log" \
-    | tee "$OUT/bench_f64.json"
+if bench_ok "$OUT/bench_f64.json"; then
+  echo "== headline bench (f64): using existing $OUT/bench_f64.json =="
+else
+  echo "== headline bench (f64, XLA kernel) =="
+  python bench.py --dtype=f64 2>"$OUT/bench_f64.stderr.log" \
+      | tee "$OUT/bench_f64.json"
+fi
 
-echo "== device sweeps (one process each: a kernel that kills the device"
-echo "   client then costs one sweep, not the rest; riskiest last) =="
-for sweep in transfer_bandwidth data_bandwidth_vector_length \
-             bandwidth_vs_avg_edges scan_bandwidth spmv_suite \
-             dist_heat_scaling heat_bandwidth pallas_tile heat_kernels; do
+for sweep in $SWEEPS; do
+    if [ -s "$OUT/$sweep.csv" ]; then
+        echo "-- $sweep: already captured"
+        continue
+    fi
+    if sweep_attempted "$OUT" "$sweep"; then
+        echo "-- $sweep: sticky failure recorded, not retrying"
+        continue
+    fi
     echo "-- $sweep"
     timeout 2700 python -m cme213_tpu.bench.run_all --out "$OUT" \
-        --only "$sweep" || echo "$sweep: FAILED (continuing)"
+        --only "$sweep" 2>"$OUT/$sweep.stderr.log"
+    rc=$?
+    cat "$OUT/$sweep.stderr.log" >&2
+    if [ "$rc" = 0 ]; then
+        rm -f "$OUT/$sweep.failed"
+    elif [ "$rc" = 124 ]; then
+        # timeout kill: stderr usually holds no device signature, but a
+        # hang IS a device failure — record one so the retry classifier
+        # re-runs this sweep next attempt
+        { echo "timeout after 2700s — device hang suspected";
+          tail -n 4 "$OUT/$sweep.stderr.log"; } > "$OUT/$sweep.failed"
+        echo "$sweep: TIMED OUT (continuing)"
+    else
+        tail -n 5 "$OUT/$sweep.stderr.log" > "$OUT/$sweep.failed"
+        echo "$sweep: FAILED (continuing)"
+    fi
 done
 
-echo "== f64 heat rows (reference's double 4th-order axis) =="
-JAX_ENABLE_X64=1 python - <<'EOF'
+f64csv="$OUT/heat_bandwidth_f64.csv"
+if [ -s "$f64csv" ]; then
+    echo "-- f64 heat rows: already captured"
+else
+    echo "== f64 heat rows (reference's double 4th-order axis) =="
+    JAX_ENABLE_X64=1 timeout 2700 python - "$f64csv" <<'EOF'
 from cme213_tpu.bench import sweeps
 import sys
 rows = sweeps.heat_sweep(sizes=(4000,), orders=(2, 4, 8), iters=100,
                          dtype="f64")
-sweeps.write_csv(rows, sys.argv[1] if len(sys.argv) > 1
-                 else "bench_results/heat_bandwidth_f64.csv")
+sweeps.write_csv(rows, sys.argv[1])
 print(f"f64 rows: {len(rows)}")
 EOF
+fi
 
-echo "capture complete: $OUT"
+# completeness: both headline benches must hold real numbers; a sweep with
+# a sticky (non-device) failure counts as attempted — only device-failure
+# gaps make the capture incomplete
+missing=0
+bench_ok "$OUT/bench_f32.json" || missing=$((missing + 1))
+bench_ok "$OUT/bench_f64.json" || missing=$((missing + 1))
+for sweep in $SWEEPS; do
+    sweep_attempted "$OUT" "$sweep" || missing=$((missing + 1))
+done
+[ -s "$f64csv" ] || missing=$((missing + 1))
+
+echo "capture complete: $OUT (unresolved items: $missing)"
+[ "$missing" -le 0 ]

@@ -11,7 +11,10 @@ Usage: python scripts/tpu_pipeline_bisect.py [--cells "nx,ny,tile,k;..."]
 """
 from __future__ import annotations
 
-import _bootstrap  # noqa: F401  — repo-root sys.path fix
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
 
 import json
 import os

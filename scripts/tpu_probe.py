@@ -1,4 +1,7 @@
-import _bootstrap  # noqa: F401  — repo-root sys.path fix
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
 import sys, time
 import jax, jax.numpy as jnp, numpy as np
 from cme213_tpu.config import SimParams

@@ -3,7 +3,10 @@
 Small shapes: fast compiles, exact or tolerance checks vs the XLA paths.
 Exit 0 = all kernels lower under Mosaic and agree with the reference paths.
 """
-import _bootstrap  # noqa: F401  — repo-root sys.path fix
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
 import sys
 
 import jax

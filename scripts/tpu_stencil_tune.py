@@ -3,7 +3,10 @@
 Correctness at small size vs the XLA path, then timing at 4000^2 over
 tile/k choices.  Dev tool, not part of the package.
 """
-import _bootstrap  # noqa: F401  — repo-root sys.path fix
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
 import sys
 import time
 

@@ -1,6 +1,9 @@
 """Time one heat-kernel config at 4000^2 order 8 on the TPU: 
 usage: tpu_time_one.py {xla | pallas TILE | multi K TILE} [iters]"""
-import _bootstrap  # noqa: F401  — repo-root sys.path fix
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
 import sys, time
 import jax, jax.numpy as jnp, numpy as np
 from cme213_tpu.config import SimParams
