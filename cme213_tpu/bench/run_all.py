@@ -76,6 +76,7 @@ def main(argv=None) -> int:
             print(f"--only: unknown sweep name(s) {sorted(unknown)}; "
                   f"choose from {sorted(known)}", file=sys.stderr)
             return 2
+    failures = 0
     for fname, job in jobs:
         if only is not None and fname[:-len(".csv")] not in only:
             continue
@@ -85,10 +86,13 @@ def main(argv=None) -> int:
         except Exception as e:
             print(f"{fname}: FAILED ({type(e).__name__}: {e})",
                   file=sys.stderr)
+            failures += 1
             continue
         sweeps.write_csv(rows, path)
         print(f"{path}: {len(rows)} rows")
-    return 0
+    # nonzero on any failed sweep so callers (tpu_capture.sh) can record
+    # a sticky-vs-device failure instead of seeing a green exit
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":

@@ -93,7 +93,9 @@ def main() -> int:
             ok = proc.returncode == 0 and '"ok": true' in proc.stdout
             tail = "" if ok else (proc.stderr.strip().splitlines() or [""])[-1][:160]
         except subprocess.TimeoutExpired:
-            ok, tail = False, "timeout"
+            # "device hang" matches capture_lib.sh's DEVICE_ERR signatures,
+            # so the autocapture watcher re-runs a drop-poisoned matrix
+            ok, tail = False, "timeout — device hang suspected"
         print(f"nx={nx} ny={ny} tile={tile} k={k} tile_x={tile_x}: "
               f"{'OK' if ok else 'FAIL ' + tail}", flush=True)
     return 0

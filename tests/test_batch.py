@@ -1,0 +1,99 @@
+"""Batch job runner (bench/batch.py) — the PBS/qsub layer.
+
+Reference model: ``hw/hw4/programming/pa4.pbs`` (OMP_NUM_THREADS sweep with
+captured ``.o``/``.e`` logs); parsing/sweep semantics are ours.
+"""
+
+import os
+
+import pytest
+
+from cme213_tpu.bench.batch import JobSpec, main, parse_job, run_job
+
+
+def _write(tmp_path, text, name="j.job"):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_parse_directives(tmp_path):
+    path = _write(tmp_path, (
+        "#CME name=myjob\n"
+        "#CME out=some/dir\n"
+        "#CME timeout=12.5\n"
+        "#CME sweep A=1,2\n"
+        "#CME sweep B=x,y,z\n"
+        "echo hello\n"))
+    spec = parse_job(path)
+    assert spec.name == "myjob"
+    assert spec.out == "some/dir"
+    assert spec.timeout == 12.5
+    assert spec.sweeps == [("A", ["1", "2"]), ("B", ["x", "y", "z"])]
+    assert spec.body == "echo hello\n"
+
+
+def test_points_cartesian_last_axis_fastest(tmp_path):
+    spec = JobSpec(name="j", sweeps=[("A", ["1", "2"]), ("B", ["x", "y"])],
+                   body="true\n")
+    assert spec.points() == [
+        {"A": "1", "B": "x"}, {"A": "1", "B": "y"},
+        {"A": "2", "B": "x"}, {"A": "2", "B": "y"},
+    ]
+
+
+def test_parse_rejects_bad_directives(tmp_path):
+    with pytest.raises(ValueError, match="unknown directive"):
+        parse_job(_write(tmp_path, "#CME nodes=2\ntrue\n"))
+    with pytest.raises(ValueError, match="bad sweep"):
+        parse_job(_write(tmp_path, "#CME sweep =1,2\ntrue\n"))
+    with pytest.raises(ValueError, match="unparseable"):
+        parse_job(_write(tmp_path, "#CME whatever\ntrue\n"))
+    with pytest.raises(ValueError, match="body is empty"):
+        parse_job(_write(tmp_path, "#CME name=x\n"))
+
+
+def test_run_captures_o_e_and_summary(tmp_path):
+    out = tmp_path / "logs"
+    spec = JobSpec(name="cap", out=str(out), timeout=60,
+                   sweeps=[("MYVAR", ["7", "8"])],
+                   body="echo val=$MYVAR\necho err=$MYVAR >&2\n")
+    rows = run_job(spec)
+    assert [r["rc"] for r in rows] == [0, 0]
+    assert (out / "cap.o0").read_text() == "val=7\n"
+    assert (out / "cap.o1").read_text() == "val=8\n"
+    assert (out / "cap.e1").read_text() == "err=8\n"
+    summary = (out / "cap.jobs.csv").read_text().splitlines()
+    assert summary[0] == "point,MYVAR,rc,seconds"
+    assert summary[1].startswith("0,7,0,")
+
+
+def test_failing_point_recorded_and_exit_nonzero(tmp_path):
+    jobfile = _write(tmp_path, (
+        "#CME out={out}\n"
+        "#CME sweep N=0,3\n"
+        "exit $N\n").format(out=tmp_path / "logs"))
+    assert main([jobfile]) == 1
+    rows = run_job(parse_job(jobfile))
+    assert [r["rc"] for r in rows] == [0, 3]
+
+
+def test_dry_run_writes_nothing(tmp_path, capsys):
+    out = tmp_path / "logs"
+    jobfile = _write(tmp_path, (
+        f"#CME out={out}\n"
+        "#CME sweep A=1,2\n"
+        "echo run\n"))
+    assert main([jobfile, "--dry-run"]) == 0
+    assert not out.exists()
+    text = capsys.readouterr().out
+    assert "A=1" in text and "A=2" in text
+
+
+def test_shipped_job_specs_parse():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for name in ("sorts_scaling", "heat_ranks"):
+        spec = parse_job(os.path.join(repo, "jobs", f"{name}.job"))
+        assert spec.name == name
+        assert spec.sweeps, name
+        assert "python -m cme213_tpu" in spec.body
