@@ -31,8 +31,9 @@ _CHILD_FLAG = "--run-measurement"
 _PREFLIGHT_EXIT = 42
 
 # candidate kernel names; each runs in its own child process
-KERNELS = ("xla", "xla-roll", "xla-conv", "pipeline-k1", "pipeline-k2",
-           "pipeline-k4", "pipeline-k8", "pipeline2d-k1", "pipeline2d-k8")
+KERNELS = ("xla", "xla-roll", "xla-roll-k8", "xla-conv", "pipeline-k1",
+           "pipeline-k2", "pipeline-k4", "pipeline-k8", "pipeline2d-k1",
+           "pipeline2d-k8")
 _EXEC_CAP_S = 30.0
 _MAX_ITERS = 400
 
@@ -65,6 +66,10 @@ def _make_candidate(name: str, params, on_tpu: bool):
     if name == "xla-roll":
         return (lambda u, it: run_heat_roll(u, it, order, params.xcfl,
                                             params.ycfl, params.bc), 1)
+    if name.startswith("xla-roll-k"):
+        k = int(name.split("-k")[1])
+        return (lambda u, it: run_heat_roll(u, it, order, params.xcfl,
+                                            params.ycfl, params.bc, k=k), k)
     if name == "xla-conv":
         return (lambda u, it: run_heat_conv(u, it, order, params.xcfl,
                                             params.ycfl), 1)

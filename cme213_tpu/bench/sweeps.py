@@ -261,6 +261,11 @@ def heat_kernel_sweep(size: int = 4000, order: int = 8,
     }
     from ..ops.stencil_pipeline import run_heat_pipeline2d
 
+    for k in ks:
+        if iters % k == 0:
+            cands[f"xla-roll-k{k}"] = (
+                iters, lambda u, k=k: run_heat_roll(u, iters, order, p.xcfl,
+                                                    p.ycfl, p.bc, k=k))
     for k in (1,) + tuple(ks):
         if iters % k == 0:
             ty = pick_pipeline_tile(p.gy, k, order)

@@ -118,10 +118,11 @@ def run_heat_conv(u: jnp.ndarray, iters: int, order: int, xcfl,
 
 
 @partial(jax.jit,
-         static_argnames=("order", "iters", "xcfl", "ycfl", "bc"),
+         static_argnames=("order", "iters", "xcfl", "ycfl", "bc", "k"),
          donate_argnums=(0,))
 def run_heat_roll(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
-                  bc: tuple[float, float, float, float]) -> jnp.ndarray:
+                  bc: tuple[float, float, float, float],
+                  k: int = 1) -> jnp.ndarray:
     """``iters`` timesteps, full-grid roll formulation.
 
     Same arithmetic as ``run_heat`` but with no interior slicing and no
@@ -131,22 +132,30 @@ def run_heat_roll(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
     wrap-around only ever lands inside the masked border band, so results
     are bitwise-identical to ``run_heat`` — but the whole update is one
     scatter-free elementwise expression XLA can fuse into a single pass.
+
+    ``k`` unrolls that many sub-steps inside each loop body (``iters`` must
+    divide by ``k``) — temporal blocking at the XLA level: the compiler
+    sees the k-step chain as one fusion candidate, the structural analog of
+    the Pallas pipeline kernel's fused sub-steps but with the tiling left
+    to XLA.  Results are bitwise-identical for every ``k``.
     """
     coeffs = STENCIL_COEFFS[order]
     b = BORDER_FOR_ORDER[order]
     gy, gx = u.shape
+    if iters % k != 0:
+        raise ValueError(f"iters={iters} must divide by k={k}")
     bc_top, bc_left, bc_bottom, bc_right = bc
     rows = jax.lax.broadcasted_iota(jnp.int32, (gy, gx), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (gy, gx), 1)
 
-    def body(_, g):
+    def substep(g):
         dtype = g.dtype
         accx = jnp.zeros_like(g)
         accy = jnp.zeros_like(g)
-        for k, c in enumerate(coeffs):
+        for kk, c in enumerate(coeffs):
             c = jnp.asarray(c, dtype)
-            accx = accx + c * jnp.roll(g, b - k, 1)
-            accy = accy + c * jnp.roll(g, b - k, 0)
+            accx = accx + c * jnp.roll(g, b - kk, 1)
+            accy = accy + c * jnp.roll(g, b - kk, 0)
         new = (g + jnp.asarray(xcfl, dtype) * accx
                + jnp.asarray(ycfl, dtype) * accy)
         new = jnp.where(rows < b, jnp.asarray(bc_bottom, dtype), new)
@@ -155,7 +164,12 @@ def run_heat_roll(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
         new = jnp.where(cols >= gx - b, jnp.asarray(bc_right, dtype), new)
         return new
 
-    return lax.fori_loop(0, iters, body, u)
+    def body(_, g):
+        for _ in range(k):
+            g = substep(g)
+        return g
+
+    return lax.fori_loop(0, iters // k, body, u)
 
 
 @partial(jax.jit, static_argnames=("order",), donate_argnums=(0,))

@@ -121,7 +121,8 @@ def run_heat_pipeline(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
     K = k * b
     kpad = _ceil_to(K, SUBLANE)
     gy, gx = u.shape
-    assert iters % k == 0, "iters must divide by k"
+    if iters % k != 0:
+        raise ValueError(f"iters={iters} must divide by k={k}")
     assert tile_y % kpad == 0, "tile_y must divide by ceil8(k*border)"
     W = _ceil_to(gx, LANE)
     GY = _ceil_to(gy, tile_y)
@@ -240,7 +241,8 @@ def run_heat_pipeline2d(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
     K = k * b
     kpad = _ceil_to(K, SUBLANE)
     gy, gx = u.shape
-    assert iters % k == 0, "iters must divide by k"
+    if iters % k != 0:
+        raise ValueError(f"iters={iters} must divide by k={k}")
     assert tile_y % kpad == 0, "tile_y must divide by ceil8(k*border)"
     assert tile_x % LANE == 0, "tile_x must divide by 128"
     assert K <= LANE, "k*border exceeds the 128-lane side halo"

@@ -29,7 +29,8 @@ def _row(name, gbs, platform="tpu"):
 
 
 def test_best_kernel_selection(monkeypatch, capsys):
-    gbs = {"xla": 14.0, "xla-roll": 100.0, "xla-conv": 0.1,
+    gbs = {"xla": 14.0, "xla-roll": 100.0, "xla-roll-k8": 120.0,
+           "xla-conv": 0.1,
            "pipeline-k1": 300.0, "pipeline-k2": 500.0,
            "pipeline-k4": 450.0, "pipeline-k8": 400.0,
            "pipeline2d-k1": 290.0, "pipeline2d-k8": 390.0}

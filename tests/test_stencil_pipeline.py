@@ -88,6 +88,12 @@ def test_roll_formulation_bitwise(order):
     out = np.asarray(run_heat_roll(jnp.array(u0), 6, order, p.xcfl,
                                    p.ycfl, p.bc))
     np.testing.assert_array_equal(out, ref)
+    # k-unrolled temporal blocking: same sub-step chain, one loop body —
+    # bitwise-equal for any k that divides iters
+    for k in (2, 3, 6):
+        out_k = np.asarray(run_heat_roll(jnp.array(u0), 6, order, p.xcfl,
+                                         p.ycfl, p.bc, k=k))
+        np.testing.assert_array_equal(out_k, ref)
 
 
 @pytest.mark.parametrize("k,tile_y,tile_x", [(1, 16, 128), (2, 8, 128),
