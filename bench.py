@@ -97,7 +97,10 @@ def _pipeline_candidates(name: str, params, k: int, on_tpu: bool):
     target = int(os.environ.get("BENCH_TILE_Y", "256"))
     tiles = []
     for t in (target, 128, 64):
-        ty = pick_pipeline_tile(params.gy, k, order, target=t)
+        # width-aware: a tile whose double-buffered band would overflow
+        # VMEM at this grid width is clamped before the compiler sees it
+        ty = pick_pipeline_tile(params.gy, k, order, target=t,
+                                width=params.gx)
         if ty not in tiles:
             tiles.append(ty)
     variants = []

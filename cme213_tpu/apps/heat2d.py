@@ -73,7 +73,7 @@ def run_single(params: SimParams, check_cpu: bool = True,
 
     # tuned Pallas path (the "shared memory" kernel analog): the pipelined
     # kernel (ops/stencil_pipeline.py)
-    tile = pick_pipeline_tile(params.gy, 1, params.order)
+    tile = pick_pipeline_tile(params.gy, 1, params.order, width=params.gx)
     interpret = jax.devices()[0].platform != "tpu"
 
     def pallas_run():

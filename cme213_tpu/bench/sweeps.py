@@ -134,7 +134,7 @@ def heat_sweep(sizes=(1000, 2000, 4000), orders=(2, 4, 8),
                     it_k = iters - iters % k
                     if not it_k:
                         continue
-                    ty = pick_pipeline_tile(p.gy, k, order)
+                    ty = pick_pipeline_tile(p.gy, k, order, width=p.gx)
                     cands.append((f"pipeline-k{k}", it_k,
                                   lambda u, k=k, ty=ty, it=it_k:
                                   run_heat_pipeline(
@@ -268,7 +268,7 @@ def heat_kernel_sweep(size: int = 4000, order: int = 8,
                                                     p.ycfl, p.bc, k=k))
     for k in (1,) + tuple(ks):
         if iters % k == 0:
-            ty = pick_pipeline_tile(p.gy, k, order)
+            ty = pick_pipeline_tile(p.gy, k, order, width=p.gx)
             cands[f"pipeline-k{k}"] = (
                 iters, lambda u, k=k, ty=ty: run_heat_pipeline(
                     u, iters, order, p.xcfl, p.ycfl, p.bc, k=k, tile_y=ty,

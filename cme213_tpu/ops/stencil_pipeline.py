@@ -180,12 +180,39 @@ def run_heat_pipeline(u: jnp.ndarray, iters: int, order: int, xcfl, ycfl,
     return padded[:gy, :gx]
 
 
-def pick_pipeline_tile(gy: int, k: int, order: int,
-                       target: int = 256) -> int:
-    """A tile_y that is a multiple of Kpad and keeps the band in VMEM."""
+# conservative per-core VMEM budget for the double-buffered band layout:
+# the core has ~16 MiB; leave headroom for scratch, constants and the
+# scalar-prefetch machinery.  (Empirically the round-3 remote-compile
+# crash boundary sits at the 16 MiB line: W=4096 x tile_y=256 needs
+# 16.5 MiB and crashes, W=3584 needs 14.4 MiB and compiles.)
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def pick_pipeline_tile(gy: int, k: int, order: int, target: int = 256,
+                       width: int | None = None,
+                       dtype_bytes: int = 4) -> int:
+    """A tile_y that is a multiple of Kpad and keeps the band in VMEM.
+
+    With ``width`` (the raw grid width ``gx``; lane-padded internally to
+    the kernel's W) given, the choice is
+    clamped so the kernel's double-buffered VMEM footprint —
+    ``2 * dtype_bytes * W * (2*tile_y + 2*kpad)`` for the center+halo
+    inputs and the output block — stays under ``VMEM_BUDGET_BYTES``,
+    so a known-over-budget tile is never even offered to the compiler
+    (a crashed remote compile can wedge the tunnel for every later
+    kernel, the BENCH_r02 failure mode).
+    """
     b = BORDER_FOR_ORDER[order]
     kpad = _ceil_to(k * b, SUBLANE)
     t = max(_ceil_to(min(target, gy), kpad), kpad)
+    if width is not None:
+        W = _ceil_to(width, LANE)
+
+        def footprint(ty: int) -> int:
+            return 2 * dtype_bytes * W * (2 * ty + 2 * kpad)
+
+        while t > kpad and footprint(t) > VMEM_BUDGET_BYTES:
+            t -= kpad
     return t
 
 

@@ -163,6 +163,8 @@ def test_pipeline_candidate_tile_ladder():
     params = SimParams(nx=4000, ny=4000, order=8, iters=8)
     variants = bench._pipeline_candidates("pipeline-k8", params, 8, True)
     labels = [l for l, _ in variants]
-    assert labels == ["tile_y=256", "tile_y=128", "tile_y=64"]
+    # the 256 target is VMEM-clamped to 160 at W=4096 (k=8) so the
+    # compiler is never offered the 17 MiB band that crashed round 3
+    assert labels == ["tile_y=160", "tile_y=128", "tile_y=64"]
     variants2d = bench._pipeline_candidates("pipeline2d-k1", params, 1, True)
     assert all("tile_x=512" in l for l, _ in variants2d)

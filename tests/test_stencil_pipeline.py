@@ -76,6 +76,26 @@ def test_pick_pipeline_tile():
     assert pick_pipeline_tile(16, 16, 8) >= 64
 
 
+def test_pick_pipeline_tile_vmem_clamp():
+    """With the grid width given, the double-buffered band footprint is
+    clamped under VMEM_BUDGET_BYTES (the W=4096 x tile_y=256 remote-compile
+    crash was 16.5 MiB against a ~16 MiB core)."""
+    from cme213_tpu.ops.stencil_pipeline import (VMEM_BUDGET_BYTES,
+                                                 _ceil_to)
+
+    for k in (1, 2, 4, 8):
+        kpad = _ceil_to(k * 4, 8)
+        ty = pick_pipeline_tile(4008, k, 8, target=256, width=4008)
+        assert ty % kpad == 0
+        W = _ceil_to(4008, 128)
+        assert 2 * 4 * W * (2 * ty + 2 * kpad) <= VMEM_BUDGET_BYTES
+        assert ty < 256  # actually clamped at the headline width
+    # narrow grids keep the requested target
+    assert pick_pipeline_tile(264, 1, 8, target=256, width=264) == 256
+    # no width → legacy behavior, no clamp
+    assert pick_pipeline_tile(4008, 1, 8, target=256) == 256
+
+
 @pytest.mark.parametrize("order", [2, 8])
 def test_roll_formulation_bitwise(order):
     """run_heat_roll (scatter-free full-grid XLA variant) vs run_heat."""
