@@ -40,6 +40,17 @@ def test_bitwise_vs_xla(order):
     np.testing.assert_array_equal(out, ref)
 
 
+def test_non_pow2_tile_bitwise():
+    """The VMEM clamp steps tiles down by halo quanta, so heights like 24
+    or 184 (multiples of kpad, not powers of two) are now reachable —
+    exercise one at each k parity."""
+    p = SimParams(nx=100, ny=90, order=8, iters=8)
+    ref, out = _run_both(p, 8, k=1, tile_y=24)
+    np.testing.assert_array_equal(out, ref)
+    ref, out = _run_both(p, 8, k=2, tile_y=24)
+    np.testing.assert_array_equal(out, ref)
+
+
 @pytest.mark.parametrize("k,tile_y", [(2, 8), (4, 16), (8, 32)])
 def test_temporal_blocking_bitwise(k, tile_y):
     p = SimParams(nx=44, ny=40, order=8, iters=8 * k)
