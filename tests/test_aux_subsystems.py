@@ -109,6 +109,7 @@ def test_heat_kernel_sweep_quick():
     rows = heat_kernel_sweep(size=32, order=2, iters=4, ks=(2, 4), tile=8)
     names = [r["kernel"] for r in rows]
     assert names == ["xla", "xla-roll", "xla-conv", "pallas-roll",
+                     "xla-roll-k2", "xla-roll-k4",
                      "pipeline-k1", "pipeline2d-k1", "pipeline-k2",
                      "pipeline2d-k2", "pipeline-k4", "pipeline2d-k4",
                      "pallas-k2", "pallas-k4"]
