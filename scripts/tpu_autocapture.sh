@@ -37,20 +37,6 @@ while true; do
     continue
   fi
   echo "$(date -Is) TPU UP — starting capture attempt" >> "$log"
-  if [ "$bisected" = 0 ]; then
-    echo "== bisect ==" >> "$log"
-    timeout 3600 python scripts/tpu_pipeline_bisect.py \
-      > /tmp/tpu_bisect_last.txt 2>&1
-    cat /tmp/tpu_bisect_last.txt >> "$log"
-    # the matrix is evidence only if no row failed for a DEVICE reason (a
-    # drop mid-matrix leaves spurious FAIL rows); sticky compile failures
-    # are exactly what the bisect is for and do not force a re-run
-    if grep -qE ": (OK|FAIL)" /tmp/tpu_bisect_last.txt \
-       && ! grep -E ": FAIL" /tmp/tpu_bisect_last.txt \
-            | grep -qE "$DEVICE_ERR"; then
-      bisected=1
-    fi
-  fi
   echo "== bench f32 ==" >> "$log"
   timeout 5400 python bench.py \
     > /tmp/tpu_bench_last.json 2>> "$log"
@@ -65,6 +51,23 @@ while true; do
     echo "== full capture ==" >> "$log"
     if SKIP_F32=1 timeout 14000 bash scripts/tpu_capture.sh bench_results \
         >> "$log" 2>&1; then
+      # the bisect deliberately offers the compiler over-budget cells, so
+      # it runs LAST — a crash-wedged tunnel then costs nothing already
+      # captured (headline + sweeps are on disk at this point)
+      if [ "$bisected" = 0 ]; then
+        echo "== bisect (diagnostics) ==" >> "$log"
+        timeout 3600 python scripts/tpu_pipeline_bisect.py \
+          > /tmp/tpu_bisect_last.txt 2>&1
+        cat /tmp/tpu_bisect_last.txt >> "$log"
+        # the matrix is evidence only if no row failed for a DEVICE
+        # reason (a drop mid-matrix leaves spurious FAIL rows); sticky
+        # compile failures are what the bisect is for
+        if grep -qE ": (OK|FAIL)" /tmp/tpu_bisect_last.txt \
+           && ! grep -E ": FAIL" /tmp/tpu_bisect_last.txt \
+                | grep -qE "$DEVICE_ERR"; then
+          bisected=1
+        fi
+      fi
       echo "$(date -Is) capture complete" >> "$log"
       touch /tmp/tpu_capture_done
       exit 0
