@@ -53,6 +53,31 @@ def stencils():
         check(f"stencil-pallas order={order}", one)
         check(f"stencil-multistep order={order}", multi)
 
+    from cme213_tpu.ops.stencil_pipeline import (run_heat_pipeline,
+                                                 run_heat_pipeline2d)
+
+    for order in (2, 4, 8):
+        p = SimParams(nx=256, ny=256, order=order, iters=8)
+        u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+        ref = np.asarray(run_heat(jnp.array(u0), 8, order, p.xcfl, p.ycfl))
+
+        def pipe(order=order, p=p, u0=u0, ref=ref):
+            for k in (1, 2, 4):
+                out = np.asarray(run_heat_pipeline(
+                    jnp.array(u0), 8, order, p.xcfl, p.ycfl, p.bc,
+                    k=k, tile_y=64))
+                assert np.array_equal(out, ref), (k, np.abs(out - ref).max())
+
+        def pipe2d(order=order, p=p, u0=u0, ref=ref):
+            for k in (1, 4):
+                out = np.asarray(run_heat_pipeline2d(
+                    jnp.array(u0), 8, order, p.xcfl, p.ycfl, p.bc,
+                    k=k, tile_y=64, tile_x=128))
+                assert np.array_equal(out, ref), (k, np.abs(out - ref).max())
+
+        check(f"stencil-pipeline order={order}", pipe)
+        check(f"stencil-pipeline2d order={order}", pipe2d)
+
 
 def segscan():
     from cme213_tpu.ops.segmented import (head_flags_from_starts,
