@@ -48,6 +48,11 @@ def main(argv=None) -> int:
          lambda: sweeps.heat_kernel_sweep(
              size=64 if q else 4000, order=8, iters=8 if q else 64,
              ks=(2, 4) if q else (2, 4, 8))),
+        ("pipeline_tune.csv",
+         lambda: sweeps.pipeline_tune_sweep(
+             size=64 if q else 4000, order=8, iters=4 if q else 64,
+             ks=(1, 2) if q else (1, 2, 4, 8, 16),
+             targets=(16,) if q else (256, 192, 128, 64))),
         ("transfer_bandwidth.csv",
          lambda: sweeps.transfer_bandwidth_sweep(
              sizes=(1 << 16,) if q else (1 << 20, 1 << 24, 1 << 27))),

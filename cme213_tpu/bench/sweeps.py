@@ -35,6 +35,21 @@ def write_csv(rows: list[dict], path: str) -> None:
         w.writerows(rows)
 
 
+def _raise_if_device_error(e: Exception) -> None:
+    """Re-raise device/tunnel failures instead of recording them as data.
+
+    A dead tunnel mid-sweep would otherwise fill the remaining cells with
+    error rows and write a 'complete' CSV that the capture layer never
+    retries — only *sticky* per-cell failures (compile/lowering bugs)
+    belong in the table; device failures must fail the sweep so
+    ``tpu_capture.sh``'s DEVICE_ERR classifier re-runs it next window.
+    """
+    msg = str(e)
+    if any(tag in msg for tag in
+           ("UNAVAILABLE", "DEADLINE", "unreachable", "device error")):
+        raise e
+
+
 def _time_ms(fn, *args, iters: int = 5) -> float:
     import jax
 
@@ -184,6 +199,70 @@ def transfer_bandwidth_sweep(sizes=(1 << 20, 1 << 24, 1 << 26)) -> list[dict]:
     return rows
 
 
+def pipeline_tune_sweep(size: int = 4000, order: int = 8, iters: int = 64,
+                        ks=(1, 2, 4, 8, 16),
+                        targets=(256, 192, 128, 64)) -> list[dict]:
+    """Tuning table for the pipelined kernels at the HEADLINE shape:
+    k (fused sub-steps per HBM pass) × tile_y ladder (VMEM-clamped at the
+    grid width) × {1-D full-width, column-tiled} — one capture window
+    yields the whole (k, tile) surface behind bench.py's best-kernel
+    pick.  Failed cells are rows with an error tag, not aborts."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import SimParams
+    from ..grid import make_initial_grid
+    from ..ops.stencil import BORDER_FOR_ORDER, flops_per_point
+    from ..ops.stencil_pipeline import (pick_pipeline_tile,
+                                        run_heat_pipeline,
+                                        run_heat_pipeline2d)
+
+    interpret = jax.devices()[0].platform != "tpu"
+    p = SimParams(nx=size, ny=size, order=order, iters=iters)
+    u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+    rows = []
+    for k in ks:
+        it_k = iters - iters % k
+        if not it_k:
+            continue
+        tiles = []
+        for tgt in targets:
+            ty = pick_pipeline_tile(p.gy, k, order, target=tgt, width=p.gx)
+            if ty not in tiles:
+                tiles.append(ty)
+        for ty in tiles:
+            cands = [(f"pipeline-k{k}",
+                      lambda u, k=k, ty=ty: run_heat_pipeline(
+                          u, it_k, order, p.xcfl, p.ycfl, p.bc, k=k,
+                          tile_y=ty, interpret=interpret))]
+            if k * BORDER_FOR_ORDER[order] <= 128:
+                cands.append((f"pipeline2d-k{k}",
+                              lambda u, k=k, ty=ty: run_heat_pipeline2d(
+                                  u, it_k, order, p.xcfl, p.ycfl, p.bc,
+                                  k=k, tile_y=ty, tile_x=512,
+                                  interpret=interpret)))
+            for name, runner in cands:
+                nbytes = 2 * 4 * size * size * it_k
+                nflops = flops_per_point(order) * size * size * it_k
+                try:
+                    jax.block_until_ready(runner(jnp.array(u0)))
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(runner(jnp.array(u0)))
+                    ms = (time.perf_counter() - t0) * 1e3
+                except Exception as e:  # a failing (k, tile) cell is data
+                    _raise_if_device_error(e)
+                    rows.append({"kernel": name, "k": k, "tile_y": ty,
+                                 "ms": -1.0, "gbs": 0.0, "gflops": 0.0,
+                                 "error": type(e).__name__})
+                    continue
+                rows.append({"kernel": name, "k": k, "tile_y": ty,
+                             "ms": round(ms, 2),
+                             "gbs": round(nbytes / 1e9 / (ms / 1e3), 2),
+                             "gflops": round(nflops / 1e9 / (ms / 1e3), 2),
+                             "error": ""})
+    return rows
+
+
 def pallas_tile_sweep(size: int = 2000, order: int = 8, iters: int = 50,
                       tiles=(40, 80, 200, 400)) -> list[dict]:
     """Effective bandwidth vs VMEM tile height for the Pallas stencil — the
@@ -293,6 +372,7 @@ def heat_kernel_sweep(size: int = 4000, order: int = 8,
             jax.block_until_ready(fn(jnp.array(u0)))
             ms = (time.perf_counter() - t0) * 1e3
         except Exception as e:  # a kernel variant failing to lower is data
+            _raise_if_device_error(e)
             rows.append({"kernel": name, "ms": -1.0, "gbs": 0.0,
                          "error": type(e).__name__})
             continue

@@ -8,7 +8,7 @@ DEVICE_ERR='UNAVAILABLE|unreachable|DEADLINE|preflight|device hang'
 
 SWEEPS="transfer_bandwidth data_bandwidth_vector_length \
 bandwidth_vs_avg_edges scan_bandwidth spmv_suite \
-dist_heat_scaling heat_bandwidth pallas_tile heat_kernels"
+dist_heat_scaling heat_bandwidth pallas_tile heat_kernels pipeline_tune"
 
 bench_ok() {  # $1 = bench json path: holds a real (non-zero) number?
   [ -s "$1" ] && grep -q '"unit": "GB/s"' "$1" \

@@ -27,13 +27,18 @@ assert d.platform == 'tpu', f'not a TPU: {d}'
 print('device:', d)
 " || { echo "preflight failed — tunnel down?"; exit 1; }
 
-if [ -s "$OUT/smoke_tpu.txt" ] && grep -q "ALL PALLAS KERNELS OK\|FAILURES" \
-     "$OUT/smoke_tpu.txt"; then
+# skip the smoke only if the recorded transcript is conclusive: all-OK, or
+# failures that are NOT device errors (a tunnel-drop transcript is retried)
+if [ -s "$OUT/smoke_tpu.txt" ] \
+   && { grep -q "ALL PALLAS KERNELS OK" "$OUT/smoke_tpu.txt" \
+        || { grep -q "FAILURES" "$OUT/smoke_tpu.txt" \
+             && ! grep -qE "$DEVICE_ERR" "$OUT/smoke_tpu.txt"; }; }; then
   echo "== pallas smoke: already recorded =="
 else
   echo "== pallas smoke (small shapes, recorded evidence) =="
-  timeout 1800 python scripts/tpu_smoke.py 2>&1 | tee "$OUT/smoke_tpu.txt" \
-    || echo "smoke had failures (recorded; continuing)"
+  if timeout 1800 python scripts/tpu_smoke.py > "$OUT/smoke_tpu.txt" 2>&1
+  then :; else echo "smoke had failures (recorded; continuing)"; fi
+  cat "$OUT/smoke_tpu.txt"
 fi
 
 if [ "${SKIP_F32:-0}" = 1 ] && bench_ok "$OUT/bench_f32.json"; then

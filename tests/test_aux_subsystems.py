@@ -103,6 +103,17 @@ def test_device_trace(tmp_path):
     assert any(tmp_path.rglob("*"))
 
 
+def test_pipeline_tune_sweep_quick():
+    from cme213_tpu.bench.sweeps import pipeline_tune_sweep
+
+    rows = pipeline_tune_sweep(size=64, order=8, iters=4, ks=(1, 2),
+                               targets=(16,))
+    # k x {1-D, column-tiled} cells, every one timed without error
+    assert {r["kernel"] for r in rows} == {"pipeline-k1", "pipeline2d-k1",
+                                           "pipeline-k2", "pipeline2d-k2"}
+    assert all(r["error"] == "" and r["ms"] > 0 for r in rows)
+
+
 def test_heat_kernel_sweep_quick():
     from cme213_tpu.bench.sweeps import heat_kernel_sweep
 
