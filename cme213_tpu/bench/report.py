@@ -1,0 +1,113 @@
+"""Render captured measurement evidence into one markdown document.
+
+The reference curates every campaign's raw numbers into spreadsheet
+tables (``hw/hw2/programming/data/data.ods``, ``hw/hw4/programming/
+data.ods``, …) next to the written analyses.  This tool is that layer:
+it scans ``bench_results/`` (device CSVs at the root, CPU sweeps under
+``cpu/``, batch campaigns under ``jobs/``) and emits ``docs/DATA.md`` —
+one table per artifact, headline bench JSONs summarized first — so the
+curated view regenerates in one command after every capture:
+
+    python -m cme213_tpu.bench.report [--dir bench_results] [--out docs/DATA.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+
+
+def _md_table(rows: list[dict]) -> str:
+    if not rows:
+        return "(empty)\n"
+    cols = list(rows[0].keys())
+    out = ["| " + " | ".join(cols) + " |",
+           "|" + "|".join("---" for _ in cols) + "|"]
+    for r in rows:
+        out.append("| " + " | ".join(str(r.get(c, "")) for c in cols) + " |")
+    return "\n".join(out) + "\n"
+
+
+def _read_csv(path: str) -> list[dict]:
+    with open(path, newline="") as f:
+        return list(csv.DictReader(f))
+
+
+def _bench_section(path: str, label: str) -> list[str]:
+    try:
+        with open(path) as f:
+            # the bench writes ONE JSON line (possibly after stderr noise
+            # in hand-captured files); take the last parseable line
+            doc = None
+            for line in f:
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        doc = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+    except OSError:
+        return []
+    if doc is None:
+        return []
+    lines = [f"## Headline bench ({label})", ""]
+    lines.append(f"- **metric**: {doc.get('metric')}")
+    lines.append(f"- **value**: {doc.get('value')} {doc.get('unit')}"
+                 f" — {doc.get('vs_baseline')}× the GTX-580 baseline"
+                 + (f", {doc.get('pct_hbm_peak')}% of HBM peak"
+                    if doc.get("pct_hbm_peak") is not None else ""))
+    kernels = doc.get("kernels")
+    if kernels:
+        lines += ["", _md_table(kernels)]
+    lines.append("")
+    return lines
+
+
+def generate(results_dir: str) -> str:
+    lines = ["# Measurement data (auto-generated)", "",
+             f"Rendered from `{results_dir}/` by "
+             "`python -m cme213_tpu.bench.report`; capture context in "
+             "`docs/REPORT.md` and `bench_results/cpu/HOST.txt`.", ""]
+    for dtype in ("f32", "f64"):
+        lines += _bench_section(
+            os.path.join(results_dir, f"bench_{dtype}.json"), dtype)
+
+    sections = [("Device sweeps", results_dir),
+                ("CPU-platform sweeps", os.path.join(results_dir, "cpu")),
+                ("Batch campaigns", os.path.join(results_dir, "jobs"))]
+    for title, d in sections:
+        if not os.path.isdir(d):
+            continue
+        csvs = sorted(f for f in os.listdir(d) if f.endswith(".csv"))
+        if not csvs:
+            continue
+        lines += [f"## {title} (`{os.path.relpath(d)}`)", ""]
+        for fname in csvs:
+            rows = _read_csv(os.path.join(d, fname))
+            lines += [f"### {fname}", "", _md_table(rows)]
+    smoke = os.path.join(results_dir, "smoke_tpu.txt")
+    if os.path.isfile(smoke):
+        with open(smoke) as f:
+            content = f.read().strip()
+        lines += ["## Pallas kernel smoke (on hardware)", "", "```",
+                  content, "```", ""]
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="bench_results")
+    ap.add_argument("--out", default="docs/DATA.md")
+    args = ap.parse_args(argv)
+    doc = generate(args.dir)
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write(doc)
+    print(f"{args.out}: {len(doc.splitlines())} lines")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,7 +4,7 @@
 
 # stderr signatures of a dead/dropped tunnel (vs a sticky kernel/compile
 # bug): such failures are retried on the next capture attempt
-DEVICE_ERR='UNAVAILABLE|unreachable|DEADLINE|preflight|device hang'
+DEVICE_ERR='UNAVAILABLE|unreachable|DEADLINE|preflight|device hang|device error'
 
 SWEEPS="transfer_bandwidth data_bandwidth_vector_length \
 bandwidth_vs_avg_edges scan_bandwidth spmv_suite \

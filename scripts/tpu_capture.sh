@@ -111,5 +111,12 @@ for sweep in $SWEEPS; do
 done
 [ -s "$f64csv" ] || missing=$((missing + 1))
 
+# regenerate the curated markdown view of whatever is captured so far —
+# only for the canonical evidence directory (a scratch-outdir trial run
+# must not clobber the committed document)
+if [ "$OUT" = "bench_results" ]; then
+  python -m cme213_tpu.bench.report --dir "$OUT" --out docs/DATA.md || true
+fi
+
 echo "capture complete: $OUT (unresolved items: $missing)"
 [ "$missing" -le 0 ]

@@ -1,0 +1,51 @@
+"""Evidence summarizer (bench/report.py) — the data.ods curation layer."""
+
+import json
+
+from cme213_tpu.bench.report import generate, main
+
+
+def _fixture(tmp_path):
+    d = tmp_path / "results"
+    (d / "cpu").mkdir(parents=True)
+    (d / "jobs").mkdir()
+    (d / "heat_bandwidth.csv").write_text(
+        "size,order,gbs\n4000,8,123.4\n")
+    (d / "cpu" / "sort_threads.csv").write_text(
+        "threads,merge_s\n1,1.9\n2,2.0\n")
+    (d / "jobs" / "camp.jobs.csv").write_text(
+        "point,rc\n0,0\n")
+    (d / "bench_f32.json").write_text(json.dumps({
+        "metric": "heat2d", "value": 123.4, "unit": "GB/s",
+        "vs_baseline": 5.15, "pct_hbm_peak": 15.1,
+        "kernels": [{"kernel": "xla", "ok": True, "gbs": 14.6}],
+    }) + "\n")
+    (d / "smoke_tpu.txt").write_text("ALL PALLAS KERNELS OK\n")
+    return d
+
+
+def test_generate_covers_all_artifacts(tmp_path):
+    doc = generate(str(_fixture(tmp_path)))
+    assert "## Headline bench (f32)" in doc
+    assert "5.15× the GTX-580 baseline, 15.1% of HBM peak" in doc
+    assert "| kernel | ok | gbs |" in doc
+    assert "### heat_bandwidth.csv" in doc
+    assert "| 4000 | 8 | 123.4 |" in doc
+    assert "### sort_threads.csv" in doc
+    assert "### camp.jobs.csv" in doc
+    assert "ALL PALLAS KERNELS OK" in doc
+
+
+def test_missing_artifacts_are_skipped(tmp_path):
+    d = tmp_path / "empty"
+    d.mkdir()
+    doc = generate(str(d))
+    assert "Headline bench" not in doc
+    assert "Device sweeps" not in doc
+
+
+def test_main_writes_file(tmp_path):
+    d = _fixture(tmp_path)
+    out = tmp_path / "DATA.md"
+    assert main(["--dir", str(d), "--out", str(out)]) == 0
+    assert out.read_text().startswith("# Measurement data")
