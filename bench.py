@@ -31,9 +31,13 @@ _CHILD_FLAG = "--run-measurement"
 _PREFLIGHT_EXIT = 42
 
 # candidate kernel names; each runs in its own child process
-KERNELS = ("xla", "xla-roll", "xla-roll-k8", "xla-conv", "pipeline-k1",
-           "pipeline-k2", "pipeline-k4", "pipeline-k8", "pipeline2d-k1",
-           "pipeline2d-k8")
+# ordered by expected value: the safe baseline first (a number on the
+# board), then the likely winners (temporal-blocking pipelines), then the
+# comparison rows; xla-conv LAST — its ~200×-slower iterations are the
+# kernel that blew the round-2 window and it is strictly diagnostic
+KERNELS = ("xla", "pipeline-k8", "pipeline-k4", "pipeline2d-k8",
+           "xla-roll-k8", "pipeline-k1", "pipeline-k2", "pipeline2d-k1",
+           "xla-roll", "xla-conv")
 _EXEC_CAP_S = 30.0
 _MAX_ITERS = 400
 
