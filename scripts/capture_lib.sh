@@ -15,6 +15,13 @@ bench_ok() {  # $1 = bench json path: holds a real (non-zero) number?
     && ! grep -q 'DEVICE UNAVAILABLE' "$1"
 }
 
+bench_complete() {  # $1: bench_ok AND no per-kernel device-failure rows —
+  # a window that closed mid-bench leaves rows like "preflight: device
+  # unreachable"; such a file is a partial result worth re-running, not
+  # final evidence
+  bench_ok "$1" && ! grep -qE "$DEVICE_ERR" "$1"
+}
+
 sweep_attempted() {  # $1 = outdir, $2 = sweep: captured, or sticky-failed?
   [ -s "$1/$2.csv" ] && return 0
   [ -s "$1/$2.failed" ] && ! grep -qE "$DEVICE_ERR" "$1/$2.failed"

@@ -41,14 +41,14 @@ else
   cat "$OUT/smoke_tpu.txt"
 fi
 
-if [ "${SKIP_F32:-0}" = 1 ] && bench_ok "$OUT/bench_f32.json"; then
+if [ "${SKIP_F32:-0}" = 1 ] && bench_complete "$OUT/bench_f32.json"; then
   echo "== headline bench (f32): using existing $OUT/bench_f32.json =="
 else
   echo "== headline bench (f32) =="
   python bench.py 2>"$OUT/bench_f32.stderr.log" | tee "$OUT/bench_f32.json"
 fi
 
-if bench_ok "$OUT/bench_f64.json"; then
+if bench_complete "$OUT/bench_f64.json"; then
   echo "== headline bench (f64): using existing $OUT/bench_f64.json =="
 else
   echo "== headline bench (f64, XLA kernel) =="
@@ -104,8 +104,8 @@ fi
 # a sticky (non-device) failure counts as attempted — only device-failure
 # gaps make the capture incomplete
 missing=0
-bench_ok "$OUT/bench_f32.json" || missing=$((missing + 1))
-bench_ok "$OUT/bench_f64.json" || missing=$((missing + 1))
+bench_complete "$OUT/bench_f32.json" || missing=$((missing + 1))
+bench_complete "$OUT/bench_f64.json" || missing=$((missing + 1))
 for sweep in $SWEEPS; do
     sweep_attempted "$OUT" "$sweep" || missing=$((missing + 1))
 done
