@@ -1,0 +1,87 @@
+"""Pin the capture retry-classification semantics (scripts/capture_lib.sh).
+
+These shell predicates decide what device evidence is final vs re-run on
+the next tunnel window — the logic has been the round's main source of
+review findings, so the truth table lives in tests.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "scripts", "capture_lib.sh")
+
+GOOD_BENCH = ('{"metric": "heat2d ...", "value": 123.4, "unit": "GB/s", '
+              '"kernels": [{"kernel": "xla", "ok": true}]}\n')
+PARTIAL_BENCH = ('{"metric": "heat2d ...", "value": 14.6, "unit": "GB/s", '
+                 '"kernels": [{"kernel": "xla", "ok": true}, '
+                 '{"kernel": "pipeline-k8", "ok": false, '
+                 '"error": "preflight: device unreachable"}]}\n')
+DEAD_BENCH = ('{"metric": "heat2d ... (DEVICE UNAVAILABLE)", "value": 0.0, '
+              '"unit": "GB/s", "vs_baseline": 0.0}\n')
+
+
+def _call(fn: str, *args: str) -> int:
+    return subprocess.run(
+        ["bash", "-c", f'. "{LIB}"; {fn} "$@"', "_", *args],
+        capture_output=True).returncode
+
+
+@pytest.mark.parametrize("content,ok,complete", [
+    (GOOD_BENCH, 0, 0),
+    (PARTIAL_BENCH, 0, 1),   # usable headline, but NOT final evidence
+    (DEAD_BENCH, 1, 1),
+    ("", 1, 1),
+])
+def test_bench_predicates(tmp_path, content, ok, complete):
+    f = tmp_path / "bench.json"
+    f.write_text(content)
+    assert _call("bench_ok", str(f)) == ok
+    assert _call("bench_complete", str(f)) == complete
+
+
+def test_bench_predicates_missing_file(tmp_path):
+    missing = str(tmp_path / "nope.json")
+    assert _call("bench_ok", missing) == 1
+    assert _call("bench_complete", missing) == 1
+
+
+def test_sweep_attempted_truth_table(tmp_path):
+    out = tmp_path
+    # captured CSV -> attempted
+    (out / "a.csv").write_text("x\n1\n")
+    assert _call("sweep_attempted", str(out), "a") == 0
+    # no csv, sticky failure record -> attempted (not retried)
+    (out / "b.failed").write_text("TypeError: bad tile\n")
+    assert _call("sweep_attempted", str(out), "b") == 0
+    # no csv, device failure record -> NOT attempted (retried next window)
+    for tag in ("UNAVAILABLE: socket closed",
+                "timeout after 2700s — device hang suspected",
+                "preflight: device unreachable",
+                "JaxRuntimeError: ... TPU device error ..."):
+        (out / "c.failed").write_text(tag + "\n")
+        assert _call("sweep_attempted", str(out), "c") == 1, tag
+    # nothing recorded -> not attempted
+    assert _call("sweep_attempted", str(out), "d") == 1
+
+
+def test_python_device_tags_subset_of_shell_classifier():
+    """_raise_if_device_error's tag set must stay a subset of DEVICE_ERR,
+    or a sweep aborted for a device reason would be classified sticky."""
+    import re
+
+    from cme213_tpu.bench.sweeps import _raise_if_device_error
+
+    src = open(LIB).read()
+    pattern = re.search(r"DEVICE_ERR='([^']+)'", src).group(1)
+    for tag in ("UNAVAILABLE", "DEADLINE", "unreachable", "device error"):
+        try:
+            _raise_if_device_error(RuntimeError(f"xx {tag} yy"))
+        except RuntimeError:
+            pass
+        else:
+            pytest.fail(f"python classifier no longer raises on {tag!r}")
+        assert re.search(pattern, f"xx {tag} yy"), (
+            f"shell DEVICE_ERR does not match python tag {tag!r}")
