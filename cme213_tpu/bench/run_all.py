@@ -37,7 +37,9 @@ def main(argv=None) -> int:
              iterations=4 if q else 20)),
         ("heat_bandwidth.csv",
          lambda: sweeps.heat_sweep(
-             sizes=(64,) if q else (1000, 2000, 4000),
+             # 5 sizes x 3 orders: the reference table's shape
+             # (hw/hw2/programming/data/data.ods measures 5 grid sizes)
+             sizes=(64,) if q else (250, 500, 1000, 2000, 4000),
              orders=(2, 4, 8), iters=3 if q else 200)),
         ("pallas_tile.csv",
          lambda: sweeps.pallas_tile_sweep(
