@@ -37,17 +37,15 @@ while true; do
     continue
   fi
   echo "$(date -Is) TPU UP — starting capture attempt" >> "$log"
-  echo "== bench f32 ==" >> "$log"
-  timeout 5400 python bench.py \
-    > /tmp/tpu_bench_last.json 2>> "$log"
-  cat /tmp/tpu_bench_last.json >> "$log"
-  # proceed to the expensive sweep capture only if the bench recorded a
-  # real kernel number this attempt; otherwise go back to waiting
-  if bench_ok /tmp/tpu_bench_last.json; then
+  # gate: ONE kernel measurement (bench.py child mode), not the full
+  # 10-kernel race — the capture runs the real f32 bench itself, and a
+  # short window shouldn't be spent proving the device twice
+  echo "== gate (single-kernel measurement) ==" >> "$log"
+  timeout 900 python bench.py --run-measurement --kernel=xla \
+    > /tmp/tpu_gate_last.json 2>> "$log"
+  cat /tmp/tpu_gate_last.json >> "$log"
+  if grep -q '"ok": true' /tmp/tpu_gate_last.json; then
     mkdir -p bench_results
-    # hand the gate run's result to tpu_capture.sh so the scarce f32
-    # headline bench isn't repeated inside the capture
-    cp /tmp/tpu_bench_last.json bench_results/bench_f32.json
     echo "== full capture ==" >> "$log"
     if SKIP_F32=1 timeout 14000 bash scripts/tpu_capture.sh bench_results \
         >> "$log" 2>&1; then
@@ -74,7 +72,7 @@ while true; do
     fi
     echo "$(date -Is) capture incomplete — re-waiting" >> "$log"
   else
-    echo "$(date -Is) bench had no usable number — re-waiting" >> "$log"
+    echo "$(date -Is) gate measurement failed — re-waiting" >> "$log"
   fi
   sleep "$INTERVAL"
 done
