@@ -158,15 +158,26 @@ def heat_sweep(sizes=(1000, 2000, 4000), orders=(2, 4, 8),
             for label, n_it, runner in cands:
                 nbytes = 2 * elem * n * n * n_it
                 nflops = flops_per_point(order) * n * n * n_it
-                jax.block_until_ready(runner(jnp.array(u0)))
-                t0 = time.perf_counter()
-                jax.block_until_ready(runner(jnp.array(u0)))
-                ms = (time.perf_counter() - t0) * 1e3
+                try:
+                    jax.block_until_ready(runner(jnp.array(u0)))
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(runner(jnp.array(u0)))
+                    ms = (time.perf_counter() - t0) * 1e3
+                except Exception as e:  # sticky per-cell failure = data
+                    _raise_if_device_error(e)
+                    rows.append({
+                        "size": n, "order": order, "kernel": label,
+                        "dtype": dtype, "iters": n_it, "ms": -1.0,
+                        "gbs": 0.0, "gflops": 0.0,
+                        "error": type(e).__name__,
+                    })
+                    continue
                 rows.append({
                     "size": n, "order": order, "kernel": label,
                     "dtype": dtype, "iters": n_it, "ms": round(ms, 2),
                     "gbs": round(nbytes / 1e9 / (ms / 1e3), 2),
                     "gflops": round(nflops / 1e9 / (ms / 1e3), 2),
+                    "error": "",
                 })
     return rows
 

@@ -66,7 +66,13 @@ for sweep in $SWEEPS; do
         continue
     fi
     echo "-- $sweep"
-    timeout 2700 python -m cme213_tpu.bench.run_all --out "$OUT" \
+    # the heavy sweeps compile tens of executables through the remote
+    # helper (~20-40 s each cold); give them a longer leash
+    case "$sweep" in
+      heat_bandwidth|pipeline_tune|heat_kernels) t=5400 ;;
+      *) t=2700 ;;
+    esac
+    timeout "$t" python -m cme213_tpu.bench.run_all --out "$OUT" \
         --only "$sweep" 2>"$OUT/$sweep.stderr.log"
     rc=$?
     cat "$OUT/$sweep.stderr.log" >&2
