@@ -27,20 +27,6 @@ assert d.platform == 'tpu', f'not a TPU: {d}'
 print('device:', d)
 " || { echo "preflight failed — tunnel down?"; exit 1; }
 
-# skip the smoke only if the recorded transcript is conclusive: all-OK, or
-# failures that are NOT device errors (a tunnel-drop transcript is retried)
-if [ -s "$OUT/smoke_tpu.txt" ] \
-   && { grep -q "ALL PALLAS KERNELS OK" "$OUT/smoke_tpu.txt" \
-        || { grep -q "FAILURES" "$OUT/smoke_tpu.txt" \
-             && ! grep -qE "$DEVICE_ERR" "$OUT/smoke_tpu.txt"; }; }; then
-  echo "== pallas smoke: already recorded =="
-else
-  echo "== pallas smoke (small shapes, recorded evidence) =="
-  if timeout 1800 python scripts/tpu_smoke.py > "$OUT/smoke_tpu.txt" 2>&1
-  then :; else echo "smoke had failures (recorded; continuing)"; fi
-  cat "$OUT/smoke_tpu.txt"
-fi
-
 if [ "${SKIP_F32:-0}" = 1 ] && bench_complete "$OUT/bench_f32.json"; then
   echo "== headline bench (f32): using existing $OUT/bench_f32.json =="
 else
@@ -54,6 +40,20 @@ else
   echo "== headline bench (f64, XLA kernel) =="
   python bench.py --dtype=f64 2>"$OUT/bench_f64.stderr.log" \
       | tee "$OUT/bench_f64.json"
+fi
+
+# skip the smoke only if the recorded transcript is conclusive: all-OK, or
+# failures that are NOT device errors (a tunnel-drop transcript is retried)
+if [ -s "$OUT/smoke_tpu.txt" ] \
+   && { grep -q "ALL PALLAS KERNELS OK" "$OUT/smoke_tpu.txt" \
+        || { grep -q "FAILURES" "$OUT/smoke_tpu.txt" \
+             && ! grep -qE "$DEVICE_ERR" "$OUT/smoke_tpu.txt"; }; }; then
+  echo "== pallas smoke: already recorded =="
+else
+  echo "== pallas smoke (small shapes, recorded evidence) =="
+  if timeout 1800 python scripts/tpu_smoke.py > "$OUT/smoke_tpu.txt" 2>&1
+  then :; else echo "smoke had failures (recorded; continuing)"; fi
+  cat "$OUT/smoke_tpu.txt"
 fi
 
 for sweep in $SWEEPS; do
@@ -82,7 +82,7 @@ for sweep in $SWEEPS; do
         # timeout kill: stderr usually holds no device signature, but a
         # hang IS a device failure — record one so the retry classifier
         # re-runs this sweep next attempt
-        { echo "timeout after 2700s — device hang suspected";
+        { echo "timeout after ${t}s — device hang suspected";
           tail -n 4 "$OUT/$sweep.stderr.log"; } > "$OUT/$sweep.failed"
         echo "$sweep: TIMED OUT (continuing)"
     else
