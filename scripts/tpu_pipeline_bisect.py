@@ -17,9 +17,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
 
 import json
-import os
 import subprocess
-import sys
 
 DEFAULT_CELLS = [
     # (nx, ny, tile_y, k, tile_x)  — tile_x 0 = full-width 1-D pipeline

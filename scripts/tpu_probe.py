@@ -2,7 +2,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
-import sys, time
+import time
 import jax, jax.numpy as jnp, numpy as np
 from cme213_tpu.config import SimParams
 from cme213_tpu.grid import make_initial_grid
