@@ -92,8 +92,24 @@ def test_dry_run_writes_nothing(tmp_path, capsys):
 
 def test_shipped_job_specs_parse():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    for name in ("sorts_scaling", "heat_ranks"):
+    for name in ("sorts_scaling", "heat_ranks", "spmv_scaling"):
         spec = parse_job(os.path.join(repo, "jobs", f"{name}.job"))
         assert spec.name == name
         assert spec.sweeps, name
         assert "python -m cme213_tpu" in spec.body
+
+
+def test_shipped_jobs_pin_platform_unconditionally():
+    """The base image pins JAX_PLATFORMS=axon globally, so a job that sets
+    the platform with a ``:-`` default keeps a (possibly dead) tunnel and
+    hangs the campaign — any platform export in a shipped job must be an
+    unconditional assignment."""
+    import glob
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for path in glob.glob(os.path.join(repo, "jobs", "*.job")):
+        body = parse_job(path).body
+        for line in body.splitlines():
+            if "JAX_PLATFORMS" in line and not line.strip().startswith("#"):
+                assert ":-" not in line, (path, line)
+                assert "JAX_PLATFORMS=" in line, (path, line)
