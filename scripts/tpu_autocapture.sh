@@ -17,6 +17,9 @@ cd "$(dirname "$0")/.."
 start=$(date +%s)
 log=/tmp/tpu_autocapture.log
 bisected=0
+bisect_tries=0
+# stale markers from a prior run must not signal this round's progress
+rm -f /tmp/tpu_evidence_done /tmp/tpu_capture_done
 
 up() {
   timeout 90 python -c "
@@ -38,8 +41,10 @@ while true; do
   fi
   echo "$(date -Is) TPU UP — starting capture attempt" >> "$log"
   # gate: ONE kernel measurement (bench.py child mode), not the full
-  # 10-kernel race — the capture runs the real f32 bench itself, and a
-  # short window shouldn't be spent proving the device twice
+  # 10-kernel race — the capture runs the real f32 bench itself.
+  # SKIP_F32=1 below only skips the f32 headline when a COMPLETE
+  # bench_f32.json already exists from a prior attempt; this gate file
+  # is never copied in, it just proves the device can hold a measurement
   echo "== gate (single-kernel measurement) ==" >> "$log"
   timeout 900 python bench.py --run-measurement --kernel=xla \
     > /tmp/tpu_gate_last.json 2>> "$log"
@@ -49,22 +54,52 @@ while true; do
     echo "== full capture ==" >> "$log"
     if SKIP_F32=1 timeout 14000 bash scripts/tpu_capture.sh bench_results \
         >> "$log" 2>&1; then
+      # evidence is on disk — mark it NOW (separate marker: the session
+      # must NOT start a tuning client yet, the watcher still owns the
+      # chip for the bisect below; /tmp/tpu_capture_done means released)
+      touch /tmp/tpu_evidence_done
       # the bisect deliberately offers the compiler over-budget cells, so
       # it runs LAST — a crash-wedged tunnel then costs nothing already
       # captured (headline + sweeps are on disk at this point)
-      if [ "$bisected" = 0 ]; then
-        echo "== bisect (diagnostics) ==" >> "$log"
+      if [ "$bisected" = 0 ] && [ "$bisect_tries" -lt 3 ]; then
+        bisect_tries=$((bisect_tries + 1))
+        echo "== bisect (diagnostics, try $bisect_tries) ==" >> "$log"
         timeout 3600 python scripts/tpu_pipeline_bisect.py \
           > /tmp/tpu_bisect_last.txt 2>&1
+        rc=$?
         cat /tmp/tpu_bisect_last.txt >> "$log"
-        # the matrix is evidence only if no row failed for a DEVICE
-        # reason (a drop mid-matrix leaves spurious FAIL rows); sticky
-        # compile failures are what the bisect is for
-        if grep -qE ": (OK|FAIL)" /tmp/tpu_bisect_last.txt \
+        if [ "$rc" != 124 ] \
+           && ! grep -qE ": (OK|FAIL)" /tmp/tpu_bisect_last.txt \
+           && ! grep -qE "$DEVICE_ERR" /tmp/tpu_bisect_last.txt; then
+          # ran to completion, no matrix rows, and no device signature in
+          # the output: a sticky startup failure (a drop at startup DOES
+          # leave a device signature and is retried) — retrying can't help
+          echo "$(date -Is) bisect sticky-failed (no rows)" >> "$log"
+          bisected=1
+        elif [ "$rc" != 124 ] \
            && ! grep -E ": FAIL" /tmp/tpu_bisect_last.txt \
                 | grep -qE "$DEVICE_ERR"; then
+          # complete matrix with no device-tagged FAIL rows: conclusive
+          # (a timeout kill rc=124 means a truncated matrix — retried)
           bisected=1
         fi
+      fi
+      if [ "$bisected" = 0 ] && [ "$bisect_tries" -lt 3 ]; then
+        # a drop (or timeout) truncated/poisoned the bisect matrix: the
+        # capture itself is done (resumable — the re-invocation above is
+        # a fast no-op), so loop back and re-run only the bisect
+        echo "$(date -Is) bisect inconclusive — re-waiting" >> "$log"
+        sleep "$INTERVAL"
+        continue
+      fi
+      if [ "$bisected" = 0 ]; then
+        # 3-try cap exhausted without a conclusive matrix — record that
+        # so the last (possibly drop-poisoned) bisect output isn't read
+        # as real compile failures
+        echo "$(date -Is) bisect gave up after $bisect_tries tries —" \
+             "matrix inconclusive" >> "$log"
+        echo "INCONCLUSIVE: truncated/drop-poisoned after" \
+             "$bisect_tries tries" >> /tmp/tpu_bisect_last.txt
       fi
       echo "$(date -Is) capture complete" >> "$log"
       touch /tmp/tpu_capture_done

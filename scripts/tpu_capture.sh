@@ -9,9 +9,10 @@
 # that failed for a non-device reason (recorded in <sweep>.failed) is not
 # retried — so the autocapture watcher can re-invoke this script across
 # tunnel drops and it only re-runs what a drop actually cost.  SKIP_F32=1
-# skips the f32 headline bench (the watcher gates on its own run and
-# copies it in).  Exit 0 = both headline benches hold real numbers and
-# every sweep has a CSV or a non-device failure record.
+# skips the f32 headline bench only when a COMPLETE bench_f32.json from a
+# prior attempt already sits in outdir (nothing is copied in from the
+# watcher's gate run).  Exit 0 = both headline benches hold real numbers
+# and every sweep has a CSV or a non-device failure record.
 set -u
 cd "$(dirname "$0")/.."
 . scripts/capture_lib.sh
@@ -86,7 +87,15 @@ for sweep in $SWEEPS; do
           tail -n 4 "$OUT/$sweep.stderr.log"; } > "$OUT/$sweep.failed"
         echo "$sweep: TIMED OUT (continuing)"
     else
-        tail -n 5 "$OUT/$sweep.stderr.log" > "$OUT/$sweep.failed"
+        # classification greps the last 60 stderr lines for device
+        # signatures: wide enough that a long final traceback can't push
+        # the signature out (the 5-line tail alone could), narrow enough
+        # that a transient recovered-UNAVAILABLE warning from early in a
+        # long run can't permanently reclassify a sticky failure as a
+        # device one (which would make the sweep retry forever)
+        { tail -n 60 "$OUT/$sweep.stderr.log" | grep -E "$DEVICE_ERR" \
+            | head -n 3;
+          tail -n 5 "$OUT/$sweep.stderr.log"; } > "$OUT/$sweep.failed"
         echo "$sweep: FAILED (continuing)"
     fi
 done
