@@ -66,13 +66,17 @@ def cipher_vector_length_sweep(steps: int = 10, max_bytes: int = 1 << 24,
                                shift: int = 17) -> list[dict]:
     import jax.numpy as jnp
 
+    from ..apps.corpus import load_corpus
     from ..ops import shift_cipher, shift_cipher_packed
 
+    # real-text input, tiled to length — the reference sweeps buffers
+    # carved from its novel input, not random bytes (loaded once: per-step
+    # reloads would re-read, or worse regenerate, the 1.25 MB corpus)
+    base = load_corpus()
     rows = []
-    rng = np.random.default_rng(0)
     for i in range(1, steps + 1):
         n = max(64, (max_bytes * i // steps) // 64 * 64)
-        data = jnp.asarray(rng.integers(32, 127, n, dtype=np.uint64).astype(np.uint8))
+        data = jnp.asarray(np.tile(base, -(-n // base.size))[:n])
         row = {"length": n}
         for name, fn in [
             ("char_gbs", lambda d: shift_cipher(d, shift)),
