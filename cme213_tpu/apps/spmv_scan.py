@@ -338,6 +338,7 @@ def main(argv: list[str]) -> int:
         spmv_scan a.txt x.txt [cpu_check] [--kernel=flat|pallas|dense]
                   [--distributed]
         spmv_scan gen a.txt x.txt [n p q [iters]] [--seed=S]
+        spmv_scan mtx matrix.mtx [cpu_check] [--kernel=...] [--seed=S]
 
     The run form loads the problem, executes the device pipeline (printing
     the spec-mandated timing line), writes ``b.txt`` (one value per line,
@@ -380,18 +381,35 @@ def main(argv: list[str]) -> int:
               f"N={prob.iters}) and {x_path}")
         return 0
 
-    if len(args) < 2:
-        print(__doc__)
-        print(main.__doc__)
-        return 2
-    a_path, x_path = args[0], args[1]
     cpu_check = len(args) > 2 and args[2] not in ("0", "false")
+    if args and args[0] == "mtx":
+        # readMM.py parity path: build the instance straight from a real
+        # MatrixMarket file (aux/readMM.py:16-63) and fall through to the
+        # normal run (b.txt, timing line, optional f64 check)
+        if len(args) < 2:
+            print("usage: spmv_scan mtx matrix.mtx [cpu_check] "
+                  "[--kernel=...] [--seed=S]")
+            return 2
+        from .matrix_market import problem_from_mtx
 
-    try:
-        prob = load_problem(a_path, x_path)
-    except (OSError, ValueError, IndexError) as e:
-        print(f"error: cannot load problem: {e}")
-        return 2
+        try:
+            prob = problem_from_mtx(args[1], seed=seed)
+        except (OSError, ValueError, IndexError) as e:
+            print(f"error: cannot load matrix: {e}")
+            return 2
+        print(f"loaded {args[1]}: n={prob.n} p={prob.p} q={prob.q} "
+              f"N={prob.iters}")
+    else:
+        if len(args) < 2:
+            print(__doc__)
+            print(main.__doc__)
+            return 2
+        a_path, x_path = args[0], args[1]
+        try:
+            prob = load_problem(a_path, x_path)
+        except (OSError, ValueError, IndexError) as e:
+            print(f"error: cannot load problem: {e}")
+            return 2
     if distributed:
         from ..dist import make_mesh_1d
 
