@@ -174,7 +174,10 @@ def measure_one(name: str, dtype_name: str) -> dict:
     iters_cal = 8 * quantum
     for label, vfn in variants:
         def timed(iters: int, vfn=vfn) -> float:
-            u = jax.device_put(u0, dev)
+            # device_put is async: block on the H2D transfer BEFORE the
+            # clock starts, or the 64 MB upload (seconds over the tunnel)
+            # lands inside the timed region and deflates every kernel
+            u = jax.block_until_ready(jax.device_put(u0, dev))
             start = time.perf_counter()
             jax.block_until_ready(vfn(u, iters))
             return time.perf_counter() - start
@@ -196,7 +199,7 @@ def measure_one(name: str, dtype_name: str) -> dict:
                 "error": f"{type(err).__name__}: {err}"}
 
     def timed(iters: int) -> float:
-        u = jax.device_put(u0, dev)
+        u = jax.block_until_ready(jax.device_put(u0, dev))
         start = time.perf_counter()
         jax.block_until_ready(fn(u, iters))
         return time.perf_counter() - start

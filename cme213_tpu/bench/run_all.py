@@ -66,7 +66,12 @@ def main(argv=None) -> int:
          lambda: sweeps.dist_heat_sweep(
              size=32 if q else 2000, order=2 if q else 8,
              iters=3 if q else 100,
-             ndevs=(1, 2) if q else (1, 2, 4, 8))),
+             ndevs=(1, 2) if q else (1, 2, 4, 8),
+             # always carry the tuned-kernel scheme: compiled on TPU,
+             # interpret-mode (slow, labeled in REPORT.md) on the CPU
+             # stand-in — so the committed CSV keeps its pallas rows
+             # however it is regenerated
+             pallas=True)),
         ("sort_threads.csv",
          lambda: sweeps.sort_thread_sweep(
              num_elements=20_000 if q else 16_000_000,

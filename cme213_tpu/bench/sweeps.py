@@ -62,6 +62,25 @@ def _time_ms(fn, *args, iters: int = 5) -> float:
     return best * 1e3
 
 
+def _time_donated_ms(runner, u0) -> float:
+    """Warmup + timed run of a buffer-donating heat loop.
+
+    Each call gets a fresh device copy of ``u0`` (the loops donate their
+    input), and the H2D upload is *blocked on before the clock starts* —
+    ``jnp.array``/``device_put`` are async, so timing ``runner(jnp.array(
+    u0))`` would otherwise hide the multi-second tunnel upload inside the
+    timed region and deflate every bandwidth column.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    jax.block_until_ready(runner(jax.block_until_ready(jnp.array(u0))))
+    u = jax.block_until_ready(jnp.array(u0))
+    t0 = time.perf_counter()
+    jax.block_until_ready(runner(u))
+    return (time.perf_counter() - t0) * 1e3
+
+
 def cipher_vector_length_sweep(steps: int = 10, max_bytes: int = 1 << 24,
                                shift: int = 17) -> list[dict]:
     import jax.numpy as jnp
@@ -163,10 +182,7 @@ def heat_sweep(sizes=(1000, 2000, 4000), orders=(2, 4, 8),
                 nbytes = 2 * elem * n * n * n_it
                 nflops = flops_per_point(order) * n * n * n_it
                 try:
-                    jax.block_until_ready(runner(jnp.array(u0)))
-                    t0 = time.perf_counter()
-                    jax.block_until_ready(runner(jnp.array(u0)))
-                    ms = (time.perf_counter() - t0) * 1e3
+                    ms = _time_donated_ms(runner, u0)
                 except Exception as e:  # sticky per-cell failure = data
                     _raise_if_device_error(e)
                     rows.append({
@@ -260,10 +276,7 @@ def pipeline_tune_sweep(size: int = 4000, order: int = 8, iters: int = 64,
                 nbytes = 2 * 4 * size * size * it_k
                 nflops = flops_per_point(order) * size * size * it_k
                 try:
-                    jax.block_until_ready(runner(jnp.array(u0)))
-                    t0 = time.perf_counter()
-                    jax.block_until_ready(runner(jnp.array(u0)))
-                    ms = (time.perf_counter() - t0) * 1e3
+                    ms = _time_donated_ms(runner, u0)
                 except Exception as e:  # a failing (k, tile) cell is data
                     _raise_if_device_error(e)
                     rows.append({"kernel": name, "k": k, "tile_y": ty,
@@ -301,10 +314,7 @@ def pallas_tile_sweep(size: int = 2000, order: int = 8, iters: int = 50,
             continue
         runner = lambda u: run_heat_pallas(u, iters, order, p.xcfl, p.ycfl,
                                            tile_y=t, interpret=interpret)
-        jax.block_until_ready(runner(jnp.array(u0)))
-        t0 = time.perf_counter()
-        jax.block_until_ready(runner(jnp.array(u0)))
-        ms = (time.perf_counter() - t0) * 1e3
+        ms = _time_donated_ms(runner, u0)
         rows.append({"tile_y": t, "ms": round(ms, 2),
                      "gbs": round(nbytes / 1e9 / (ms / 1e3), 2)})
     return rows
@@ -382,10 +392,7 @@ def heat_kernel_sweep(size: int = 4000, order: int = 8,
     for name, (n_it, fn) in cands.items():
         nbytes = 2 * 4 * size * size * n_it
         try:
-            jax.block_until_ready(fn(jnp.array(u0)))  # same-iters warmup
-            t0 = time.perf_counter()
-            jax.block_until_ready(fn(jnp.array(u0)))
-            ms = (time.perf_counter() - t0) * 1e3
+            ms = _time_donated_ms(fn, u0)  # same-iters warmup inside
         except Exception as e:  # a kernel variant failing to lower is data
             _raise_if_device_error(e)
             rows.append({"kernel": name, "ms": -1.0, "gbs": 0.0,
@@ -430,10 +437,18 @@ def sort_thread_sweep(num_elements: int = 1_000_000,
 
 
 def dist_heat_sweep(size: int = 256, order: int = 8, iters: int = 20,
-                    ndevs=(1, 2, 4, 8)) -> list[dict]:
+                    ndevs=(1, 2, 4, 8),
+                    pallas: bool | None = None) -> list[dict]:
     """Strong-scaling table for the distributed heat solver: device count ×
     {1D stripes, 2D blocks} × {sync, overlapped} — the hw5 measurement grid
-    (``hw/hw5/programming/data.ods``; BASELINE.md hw5 table)."""
+    (``hw/hw5/programming/data.ods``; BASELINE.md hw5 table).
+
+    ``pallas`` adds the tuned per-shard-kernel scheme (``pallas-k4``).
+    Default (None): only on TPU, where the kernel is compiled — off-TPU it
+    runs in interpret mode, slow enough that the row is opt-in (the CPU
+    stand-in capture opts in so the scaling table carries the scheme the
+    TPU capture measures).
+    """
     import jax
 
     from ..config import GridMethod, SimParams
@@ -443,8 +458,9 @@ def dist_heat_sweep(size: int = 256, order: int = 8, iters: int = 20,
     avail = len(jax.devices())
     schemes = [("sync", False, 1, "xla"), ("async", True, 1, "xla"),
                ("ca-k4", False, 4, "xla")]
-    if jax.devices()[0].platform == "tpu":
-        # tuned per-shard kernel (interpret mode at sweep sizes is ~1000×)
+    if pallas is None:
+        pallas = jax.devices()[0].platform == "tpu"
+    if pallas:
         schemes.append(("pallas-k4", False, 4, "pallas"))
     for nd in ndevs:
         if nd > avail:

@@ -1,31 +1,78 @@
-"""Time one heat-kernel config at 4000^2 order 8 on the TPU: 
-usage: tpu_time_one.py {xla | pallas TILE | multi K TILE} [iters]"""
+"""Time one heat-kernel config at 4000^2 order 8 on the TPU.
+
+usage: tpu_time_one.py xla [iters]
+       tpu_time_one.py pallas TILE [iters]          (stencil_pallas roll)
+       tpu_time_one.py multi K TILE [iters]         (stencil_pallas k-step)
+       tpu_time_one.py pipe K TILE [iters]          (pipeline, 1-D tiles)
+       tpu_time_one.py pipe2d K TILE TILE_X [iters] (pipeline, 2-D tiles)
+
+The post-capture tuning tool: one (kernel, tile, k) cell per invocation,
+own process, so a crashed compile can't poison a longer campaign.  Run
+ONLY when the capture watcher is done (/tmp/tpu_capture_done) — one TPU
+client at a time.
+"""
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
 import time
-import jax, jax.numpy as jnp, numpy as np
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
 from cme213_tpu.config import SimParams
 from cme213_tpu.grid import make_initial_grid
 from cme213_tpu.ops import run_heat
 from cme213_tpu.ops.stencil_pallas import run_heat_multistep, run_heat_pallas
+from cme213_tpu.ops.stencil_pipeline import (run_heat_pipeline,
+                                             run_heat_pipeline2d)
 
 p = SimParams(nx=4000, ny=4000, order=8, iters=1000)
 u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
 mode = sys.argv[1]
-iters = int(sys.argv[-1]) if sys.argv[-1].isdigit() and len(sys.argv) > (3 if mode != "xla" else 2) + (1 if mode == "multi" else 0) else 200
+args = sys.argv[2:]
+
+
+def _pop_int() -> int:
+    try:
+        return int(args.pop(0))
+    except (IndexError, ValueError):
+        raise SystemExit(__doc__)
+
+
 if mode == "xla":
     fn = lambda u, it: run_heat(u, it, p.order, p.xcfl, p.ycfl)
 elif mode == "pallas":
-    t = int(sys.argv[2])
-    fn = lambda u, it: run_heat_pallas(u, it, p.order, p.xcfl, p.ycfl, tile_y=t)
+    t = _pop_int()
+    fn = lambda u, it: run_heat_pallas(u, it, p.order, p.xcfl, p.ycfl,
+                                       tile_y=t)
+elif mode == "multi":
+    k, t = _pop_int(), _pop_int()
+    fn = lambda u, it: run_heat_multistep(u, it, p.order, p.xcfl, p.ycfl,
+                                          p.bc, k=k, tile_y=t)
+elif mode == "pipe":
+    k, t = _pop_int(), _pop_int()
+    fn = lambda u, it: run_heat_pipeline(u, it, p.order, p.xcfl, p.ycfl,
+                                         p.bc, k=k, tile_y=t)
+elif mode == "pipe2d":
+    k, t, tx = _pop_int(), _pop_int(), _pop_int()
+    fn = lambda u, it: run_heat_pipeline2d(u, it, p.order, p.xcfl, p.ycfl,
+                                           p.bc, k=k, tile_y=t, tile_x=tx)
 else:
-    k, t = int(sys.argv[2]), int(sys.argv[3])
-    fn = lambda u, it: run_heat_multistep(u, it, p.order, p.xcfl, p.ycfl, p.bc, k=k, tile_y=t)
-jax.block_until_ready(fn(jax.device_put(u0), 8))
-u = jax.device_put(u0)
+    raise SystemExit(__doc__)
+
+iters = _pop_int() if args else 200
+if mode in ("multi", "pipe", "pipe2d"):
+    # k-step kernels need iters to divide by k; never round down to zero
+    iters = max(iters - iters % k, k)
+# warmup/compile at both iteration counts; block the H2D upload BEFORE the
+# clock (device_put is async — an unblocked put hides the 64 MB tunnel
+# upload inside the timed region)
+jax.block_until_ready(fn(jax.block_until_ready(jax.device_put(u0)), iters))
+u = jax.block_until_ready(jax.device_put(u0))
 t0 = time.perf_counter()
 jax.block_until_ready(fn(u, iters))
 dt = (time.perf_counter() - t0) / iters
-print(f"{' '.join(sys.argv[1:])}: {dt*1e3:.3f} ms/iter, {2*4*4000*4000/dt/1e9:.1f} GB/s eff", flush=True)
+print(f"{' '.join(sys.argv[1:])}: {dt*1e3:.3f} ms/iter, "
+      f"{2*4*4000*4000/dt/1e9:.1f} GB/s eff", flush=True)
