@@ -31,9 +31,17 @@ def pagerank_propagate(row_ids: jnp.ndarray, edges: jnp.ndarray,
                        rank_in: jnp.ndarray, inv_deg: jnp.ndarray,
                        num_nodes: int) -> jnp.ndarray:
     """One sweep: ``out[i] = 0.5/n + 0.5 · Σ_{j∈row i} rank[e_j]·inv_deg[e_j]``
-    (pagerank.cu:45-56 math, edge-parallel form)."""
+    (pagerank.cu:45-56 math, edge-parallel form).
+
+    Precondition: ``row_ids`` must be non-decreasing (as produced by
+    ``csr_row_ids``) — the sorted segment reduction is undefined for
+    unsorted ids."""
     contrib = rank_in[edges] * inv_deg[edges]
-    sums = jax.ops.segment_sum(contrib, row_ids, num_segments=num_nodes)
+    # CSR edge order makes row_ids non-decreasing; telling XLA lets the
+    # TPU backend lower a sorted segment reduction instead of a general
+    # scatter-add over 16M edges
+    sums = jax.ops.segment_sum(contrib, row_ids, num_segments=num_nodes,
+                               indices_are_sorted=True)
     half = jnp.float32(0.5)
     return half / jnp.float32(num_nodes) + half * sums
 

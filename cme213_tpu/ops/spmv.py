@@ -27,9 +27,16 @@ import numpy as np
 def csr_spmv(row_ids: jnp.ndarray, col_idx: jnp.ndarray, values: jnp.ndarray,
              x: jnp.ndarray, num_rows: int) -> jnp.ndarray:
     """y = A·x with A given as flat (row_ids, col_idx, values) triplets
-    (row_ids precomputed from CSR offsets via ``ops.gather.csr_row_ids``)."""
+    (row_ids precomputed from CSR offsets via ``ops.gather.csr_row_ids``).
+
+    Precondition: ``row_ids`` must be non-decreasing (CSR order) — the
+    sorted segment reduction is undefined for unsorted ids; sort COO
+    triplets by row before calling."""
     contrib = values * x[col_idx]
-    return jax.ops.segment_sum(contrib, row_ids, num_segments=num_rows)
+    # row_ids derived from CSR offsets are non-decreasing — the sorted
+    # lowering avoids a general scatter-add on TPU
+    return jax.ops.segment_sum(contrib, row_ids, num_segments=num_rows,
+                               indices_are_sorted=True)
 
 
 @jax.jit
