@@ -18,8 +18,11 @@ start=$(date +%s)
 log=/tmp/tpu_autocapture.log
 bisected=0
 bisect_tries=0
+polls=0
 # stale markers from a prior run must not signal this round's progress
 rm -f /tmp/tpu_evidence_done /tmp/tpu_capture_done
+echo "$(date -Is) watcher started (interval ${INTERVAL}s," \
+     "deadline ${DEADLINE}s)" >> "$log"
 
 up() {
   timeout 90 python -c "
@@ -36,6 +39,13 @@ while true; do
     exit 1
   fi
   if ! up; then
+    polls=$((polls + 1))
+    # heartbeat: without it a never-opening tunnel leaves an empty log,
+    # indistinguishable from a watcher that never ran
+    if [ $((polls % 10)) = 0 ]; then
+      echo "$(date -Is) still polling (attempt $polls, tunnel down)" \
+        >> "$log"
+    fi
     sleep "$INTERVAL"
     continue
   fi

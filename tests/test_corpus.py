@@ -79,3 +79,13 @@ def test_crack_roundtrip_at_full_scale(corpus):
 def test_load_corpus_tiles_to_length():
     data = load_corpus(3_000_000)
     assert data.size == 3_000_000
+
+
+def test_generator_never_short():
+    """The word pool redraws when sentence draws skew long — the output
+    must reach the requested size for any (size, seed), including sizes
+    far above the initial block estimate."""
+    for n, seed in [(500, 0), (5_000, 11), (10_000, 3), (40_000, 7)]:
+        data = make_english_corpus(n, seed)
+        assert len(data) >= n, (n, seed, len(data))
+        assert data.decode("ascii")  # stays pure ASCII
