@@ -7,8 +7,9 @@
 DEVICE_ERR='UNAVAILABLE|unreachable|DEADLINE|preflight|device hang|device error'
 
 SWEEPS="transfer_bandwidth data_bandwidth_vector_length \
-bandwidth_vs_avg_edges scan_bandwidth spmv_suite \
-dist_heat_scaling heat_bandwidth pallas_tile heat_kernels pipeline_tune"
+bandwidth_vs_avg_edges scan_bandwidth spmv_pallas_coverage spmv_suite \
+dist_heat_scaling dist_heat_compile_coverage \
+heat_bandwidth pallas_tile heat_kernels pipeline_tune"
 
 bench_ok() {  # $1 = bench json path: holds a real (non-zero) number?
   [ -s "$1" ] && grep -q '"unit": "GB/s"' "$1" \
@@ -25,4 +26,29 @@ bench_complete() {  # $1: bench_ok AND no per-kernel device-failure rows —
 sweep_attempted() {  # $1 = outdir, $2 = sweep: captured, or sticky-failed?
   [ -s "$1/$2.csv" ] && return 0
   [ -s "$1/$2.failed" ] && ! grep -qE "$DEVICE_ERR" "$1/$2.failed"
+}
+
+row_ok() {  # $1 = per-kernel row json (bench.py child mode): real number?
+  [ -s "$1" ] && grep -q '"ok": true' "$1"
+}
+
+row_conclusive() {  # $1: banked number, or a sticky (non-device) failure —
+  # a compile bug is a result worth keeping; a device-tagged failure is
+  # retried on the next tunnel window
+  [ -s "$1" ] && { grep -q '"ok": true' "$1" \
+                   || ! grep -qE "$DEVICE_ERR" "$1"; }
+}
+
+failure_signature() {  # $1 = stderr log: device-signature lines from the
+  # FINAL failure only — the last traceback if one exists, else the last
+  # 15 lines.  Anchoring to the failure itself (not a fixed 60-line
+  # window) keeps a transient recovered-UNAVAILABLE warning that merely
+  # sits near the end of a long sticky-failure log from writing a device
+  # signature into <sweep>.failed, which would make the sweep retry
+  # until the deadline.
+  awk '/Traceback \(most recent call last\)/ { n = NR }
+       { l[NR] = $0 }
+       END { s = n ? n : (NR > 15 ? NR - 14 : 1)
+             for (i = s; i <= NR; i++) print l[i] }' "$1" \
+    | grep -E "$DEVICE_ERR" | head -n 3
 }

@@ -50,24 +50,26 @@ while true; do
     continue
   fi
   echo "$(date -Is) TPU UP — starting capture attempt" >> "$log"
-  # gate: ONE kernel measurement (bench.py child mode), not the full
-  # 10-kernel race — the capture runs the real f32 bench itself.
-  # SKIP_F32=1 below only skips the f32 headline when a COMPLETE
-  # bench_f32.json already exists from a prior attempt; this gate file
-  # is never copied in, it just proves the device can hold a measurement
-  echo "== gate (single-kernel measurement) ==" >> "$log"
-  timeout 900 python bench.py --run-measurement --kernel=xla \
-    > /tmp/tpu_gate_last.json 2>> "$log"
-  cat /tmp/tpu_gate_last.json >> "$log"
-  if grep -q '"ok": true' /tmp/tpu_gate_last.json; then
+  # tranche 1: the first ~120 s of any window bank (and git-commit) the
+  # headline xla re-measure + one pipeline-k4 point + the transfer sweep
+  # — so even a 3-minute window leaves committed device rows.  It doubles
+  # as the gate: a device that can't hold these measurements can't hold
+  # the full capture either.  SKIP_F32=1 below only skips the f32
+  # headline when a COMPLETE bench_f32.json already exists from a prior
+  # attempt; tranche rows are never copied into it.
+  echo "== tranche 1 (first-window bank) ==" >> "$log"
+  if timeout 2700 bash scripts/tpu_tranche1.sh bench_results \
+      >> "$log" 2>&1; then
+    # committed device evidence exists from here on
+    touch /tmp/tpu_evidence_done
     mkdir -p bench_results
     echo "== full capture ==" >> "$log"
     if SKIP_F32=1 timeout 14000 bash scripts/tpu_capture.sh bench_results \
         >> "$log" 2>&1; then
-      # evidence is on disk — mark it NOW (separate marker: the session
-      # must NOT start a tuning client yet, the watcher still owns the
-      # chip for the bisect below; /tmp/tpu_capture_done means released)
-      touch /tmp/tpu_evidence_done
+      # full-capture evidence is on disk too (the marker was already set
+      # after tranche 1; the session must still NOT start a tuning client
+      # — the watcher owns the chip for the bisect below;
+      # /tmp/tpu_capture_done means released)
       # the bisect deliberately offers the compiler over-budget cells, so
       # it runs LAST — a crash-wedged tunnel then costs nothing already
       # captured (headline + sweeps are on disk at this point)
@@ -87,9 +89,13 @@ while true; do
           echo "$(date -Is) bisect sticky-failed (no rows)" >> "$log"
           bisected=1
         elif [ "$rc" != 124 ] \
-           && ! grep -E ": FAIL" /tmp/tpu_bisect_last.txt \
-                | grep -qE "$DEVICE_ERR"; then
-          # complete matrix with no device-tagged FAIL rows: conclusive
+           && grep -qE ": (OK|FAIL)" /tmp/tpu_bisect_last.txt \
+           && ! grep -qE "$DEVICE_ERR" /tmp/tpu_bisect_last.txt; then
+          # actual matrix rows present AND no device signature anywhere:
+          # conclusive.  The rows-exist conjunct catches the zero-row
+          # startup drop; the blanket device-signature conjunct catches a
+          # drop AFTER some OK rows (truncated matrix, rc!=124) — both
+          # land in the retry path below, not here
           # (a timeout kill rc=124 means a truncated matrix — retried)
           bisected=1
         fi
@@ -117,7 +123,7 @@ while true; do
     fi
     echo "$(date -Is) capture incomplete — re-waiting" >> "$log"
   else
-    echo "$(date -Is) gate measurement failed — re-waiting" >> "$log"
+    echo "$(date -Is) tranche 1 incomplete — re-waiting" >> "$log"
   fi
   sleep "$INTERVAL"
 done

@@ -87,18 +87,40 @@ for sweep in $SWEEPS; do
           tail -n 4 "$OUT/$sweep.stderr.log"; } > "$OUT/$sweep.failed"
         echo "$sweep: TIMED OUT (continuing)"
     else
-        # classification greps the last 60 stderr lines for device
-        # signatures: wide enough that a long final traceback can't push
-        # the signature out (the 5-line tail alone could), narrow enough
-        # that a transient recovered-UNAVAILABLE warning from early in a
-        # long run can't permanently reclassify a sticky failure as a
-        # device one (which would make the sweep retry forever)
-        { tail -n 60 "$OUT/$sweep.stderr.log" | grep -E "$DEVICE_ERR" \
-            | head -n 3;
+        # classification is anchored to the final failure itself (last
+        # traceback, else last 15 lines — capture_lib.failure_signature):
+        # wide enough that a long final traceback can't push the
+        # signature out, and a recovered-UNAVAILABLE warning that merely
+        # sits near the end of a sticky-failure log can't reclassify it
+        # as a device failure (which would make the sweep retry forever)
+        { failure_signature "$OUT/$sweep.stderr.log";
           tail -n 5 "$OUT/$sweep.stderr.log"; } > "$OUT/$sweep.failed"
         echo "$sweep: FAILED (continuing)"
     fi
 done
+
+# XPlane overlap evidence (SURVEY §7: overlap verified from profiles, not
+# assumed) — sync/async/CA wall-clock rows + a device trace of the async
+# scheme.  Retried across windows like a sweep (same .failed protocol).
+if [ -s "$OUT/overlap_sync_vs_async.csv" ] \
+   && find "$OUT/xplane_overlap" -name "*.xplane.pb" 2>/dev/null \
+      | grep -q .; then
+    echo "-- overlap trace: already captured"
+elif sweep_attempted "$OUT" "overlap_sync_vs_async"; then
+    echo "-- overlap trace: sticky failure recorded, not retrying"
+else
+    echo "== overlap XPlane trace (P11 profile evidence) =="
+    if timeout 2700 python scripts/tpu_overlap_trace.py "$OUT" \
+        2>"$OUT/overlap_sync_vs_async.stderr.log"; then
+        rm -f "$OUT/overlap_sync_vs_async.failed"
+    else
+        cat "$OUT/overlap_sync_vs_async.stderr.log" >&2
+        { failure_signature "$OUT/overlap_sync_vs_async.stderr.log";
+          tail -n 5 "$OUT/overlap_sync_vs_async.stderr.log"; } \
+            > "$OUT/overlap_sync_vs_async.failed"
+        echo "overlap trace: FAILED (continuing)"
+    fi
+fi
 
 f64csv="$OUT/heat_bandwidth_f64.csv"
 if [ -s "$f64csv" ]; then
@@ -125,6 +147,7 @@ for sweep in $SWEEPS; do
     sweep_attempted "$OUT" "$sweep" || missing=$((missing + 1))
 done
 [ -s "$f64csv" ] || missing=$((missing + 1))
+sweep_attempted "$OUT" "overlap_sync_vs_async" || missing=$((missing + 1))
 
 # regenerate the curated markdown view of whatever is captured so far —
 # only for the canonical evidence directory (a scratch-outdir trial run

@@ -1,0 +1,78 @@
+"""Capture device-profile evidence for the overlap (P11) and CA schemes.
+
+SURVEY §7: overlap "must be verified from profiles, not assumed".  This
+runs the 2000² order-8 distributed step on the available mesh (mesh=1 on
+the single bench chip), records sync-vs-async-vs-CA wall-clock rows
+(the analog of the hw5 measured table, ``hw/hw5/programming/data.ods``),
+and wraps one async run in ``core.trace.device_trace`` so the XPlane
+trace shows whether the ppermute halo exchange and the interior compute
+actually overlap.
+
+usage: tpu_overlap_trace.py [outdir]
+
+Writes ``<outdir>/overlap_sync_vs_async.csv`` and an XPlane trace under
+``<outdir>/xplane_overlap/``.  One TPU client at a time — run only from
+the capture watcher or after /tmp/tpu_capture_done exists.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
+from cme213_tpu.core.platform import apply_platform_env
+
+apply_platform_env()
+
+import jax  # noqa: E402
+
+from cme213_tpu.bench.sweeps import write_csv  # noqa: E402
+from cme213_tpu.config import GridMethod, SimParams  # noqa: E402
+from cme213_tpu.core.trace import device_trace  # noqa: E402
+from cme213_tpu.dist import (mesh_for_method,  # noqa: E402
+                             prepare_distributed_heat)
+
+
+def main() -> int:
+    out = sys.argv[1] if len(sys.argv) > 1 else "bench_results"
+    os.makedirs(out, exist_ok=True)
+    size, order, iters = 2000, 8, 100
+    nd = len(jax.devices())
+    mesh = mesh_for_method(GridMethod.STRIPES_1D, nd)
+    print(f"devices={nd} platform={jax.devices()[0].platform}")
+
+    rows = []
+    traced = None
+    for requested, overlap, k in (("sync", False, 1), ("async", True, 1),
+                                  ("ca-k4", False, 4)):
+        p = SimParams(nx=size, ny=size, order=order, iters=iters)
+        iterate, used_overlap, used_k = prepare_distributed_heat(
+            p, mesh, overlap=overlap, steps_per_exchange=k)
+        iterate()                   # warmup: same iters → same executable
+        secs, _ = iterate()
+        scheme = (f"ca-k{used_k}" if used_k > 1
+                  else "async" if used_overlap else "sync")
+        rows.append({"devices": nd, "size": size, "order": order,
+                     "iters": iters, "requested": requested,
+                     "scheme": scheme, "seconds": round(secs, 4)})
+        print(rows[-1])
+        if requested == "async":
+            traced = iterate
+
+    tracedir = os.path.join(out, "xplane_overlap")
+    with device_trace(tracedir):
+        traced()
+    # the trace is the deliverable: fail loudly if nothing was written —
+    # and only then write the CSV, so a drop mid-trace leaves no CSV and
+    # the capture's sweep_attempted classifier retries the whole step
+    # next window instead of reading the CSV as "already captured"
+    found = [os.path.join(r, f) for r, _, fs in os.walk(tracedir)
+             for f in fs if f.endswith(".xplane.pb")]
+    print(f"xplane files: {found}")
+    if not found:
+        return 1
+    write_csv(rows, os.path.join(out, "overlap_sync_vs_async.csv"))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

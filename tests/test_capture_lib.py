@@ -67,6 +67,68 @@ def test_sweep_attempted_truth_table(tmp_path):
     assert _call("sweep_attempted", str(out), "d") == 1
 
 
+def test_row_predicates_truth_table(tmp_path):
+    """tranche-1 per-kernel rows: ok = real number; conclusive = real
+    number OR sticky failure (device-tagged failures are retried)."""
+    cases = [
+        ('{"kernel": "xla", "ok": true, "gbs": 123.4}', 0, 0),
+        # sticky compile bug: evidence, not retried
+        ('{"kernel": "pipeline-k4", "ok": false, '
+         '"error": "TypeError: bad tile"}', 1, 0),
+        # device-tagged failures: retried next window
+        ('{"kernel": "xla", "ok": false, '
+         '"error": "preflight: device unreachable"}', 1, 1),
+        ('{"kernel": "xla", "ok": false, '
+         '"error": "UNAVAILABLE: socket closed"}', 1, 1),
+        ("", 1, 1),
+    ]
+    for content, ok, conclusive in cases:
+        f = tmp_path / "row.json"
+        f.write_text(content)
+        assert _call("row_ok", str(f)) == ok, content
+        assert _call("row_conclusive", str(f)) == conclusive, content
+    missing = str(tmp_path / "nope.json")
+    assert _call("row_ok", missing) == 1
+    assert _call("row_conclusive", missing) == 1
+
+
+def _signature(log_text: str, tmp_path) -> str:
+    f = tmp_path / "sweep.stderr.log"
+    f.write_text(log_text)
+    out = subprocess.run(
+        ["bash", "-c", f'. "{LIB}"; failure_signature "$1"', "_", str(f)],
+        capture_output=True, text=True)
+    return out.stdout
+
+
+def test_failure_signature_anchors_to_final_failure(tmp_path):
+    """A recovered-UNAVAILABLE warning that merely sits near the end of a
+    long sticky-failure log must NOT produce a device signature; a device
+    error inside the final traceback (or final lines) must."""
+    sticky_tail = "\n".join(f"frame {i}" for i in range(20))
+    # transient warning 10 lines from the end, then a sticky TypeError
+    # traceback: the old 60-line window classified this as a device failure
+    log = ("working...\nUNAVAILABLE: transient, recovered\n"
+           + "\n".join(f"progress {i}" for i in range(8))
+           + "\nTraceback (most recent call last):\n" + sticky_tail
+           + "\nTypeError: unsupported tile\n")
+    assert _signature(log, tmp_path) == ""
+    # device error in the final traceback: signature found even when the
+    # traceback is longer than any fixed tail window
+    log = ("noise\n" * 30 + "Traceback (most recent call last):\n"
+           + sticky_tail + "\njaxlib.JaxRuntimeError: UNAVAILABLE: dead\n")
+    assert "UNAVAILABLE" in _signature(log, tmp_path)
+    # no traceback at all: the run_all FAILED line within the last 15
+    # lines carries the tag
+    log = ("noise\n" * 30
+           + "spmv_suite.csv: FAILED (RuntimeError: DEADLINE exceeded)\n")
+    assert "DEADLINE" in _signature(log, tmp_path)
+    # ...but an early transient warning with a sticky final line does not
+    log = ("UNAVAILABLE: transient, recovered\n" + "noise\n" * 30
+           + "heat_kernels.csv: FAILED (ValueError: bad order)\n")
+    assert _signature(log, tmp_path) == ""
+
+
 def test_python_device_tags_subset_of_shell_classifier():
     """_raise_if_device_error's tag set must stay a subset of DEVICE_ERR,
     or a sweep aborted for a device reason would be classified sticky."""
