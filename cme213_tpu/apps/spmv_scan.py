@@ -390,10 +390,16 @@ def main(argv: list[str]) -> int:
             print("usage: spmv_scan mtx matrix.mtx [cpu_check] "
                   "[--kernel=...] [--seed=S]")
             return 2
-        from .matrix_market import problem_from_mtx
+        from .matrix_market import dense2_problem, problem_from_mtx
 
         try:
-            prob = problem_from_mtx(args[1], seed=seed)
+            if args[1] == "dense2":
+                # built-in reconstruction: the dense 2000×2000 instance is
+                # fully pattern-determined, built in memory instead of via
+                # a ~60 MB .mtx text detour (see matrix_market.dense2_problem)
+                prob = dense2_problem(iters=None, seed=seed)
+            else:
+                prob = problem_from_mtx(args[1], seed=seed)
         except (OSError, ValueError, IndexError) as e:
             print(f"error: cannot load matrix: {e}")
             return 2

@@ -20,9 +20,13 @@ import os
 
 
 def _md_table(rows: list[dict]) -> str:
+    rows = [r for r in rows if r]
     if not rows:
         return "(empty)\n"
-    cols = list(rows[0].keys())
+    # column union across rows, first-seen order: heterogeneous rows
+    # (e.g. a failure row sorted before a measured row in the tranche-1
+    # table) must not hide the measured row's value columns
+    cols = list(dict.fromkeys(c for r in rows for c in r))
     out = ["| " + " | ".join(cols) + " |",
            "|" + "|".join("---" for _ in cols) + "|"]
     for r in rows:
@@ -74,6 +78,23 @@ def generate(results_dir: str) -> str:
         lines += _bench_section(
             os.path.join(results_dir, f"bench_{dtype}.json"), dtype)
 
+    # first-window banked rows (tpu_tranche1.sh): per-kernel JSON rows
+    # committed before the long sweeps — shown even when a later full
+    # bench supersedes them, as the capture-provenance record
+    tranche = sorted(f for f in (os.listdir(results_dir)
+                                 if os.path.isdir(results_dir) else [])
+                     if f.startswith("tranche1_") and f.endswith(".json"))
+    t_rows = []
+    for fname in tranche:
+        try:
+            with open(os.path.join(results_dir, fname)) as f:
+                t_rows.append(json.loads(f.read().strip() or "{}"))
+        except (OSError, json.JSONDecodeError):
+            continue
+    if t_rows:
+        lines += ["## First-window banked rows (tranche 1)", "",
+                  _md_table(t_rows)]
+
     sections = [("Device sweeps", results_dir),
                 ("CPU-platform sweeps", os.path.join(results_dir, "cpu")),
                 ("Batch campaigns", os.path.join(results_dir, "jobs"))]
@@ -86,7 +107,14 @@ def generate(results_dir: str) -> str:
         lines += [f"## {title} (`{os.path.relpath(d)}`)", ""]
         for fname in csvs:
             rows = _read_csv(os.path.join(d, fname))
-            lines += [f"### {fname}", "", _md_table(rows)]
+            lines += [f"### {fname}", ""]
+            if "compile_coverage" in fname:
+                lines += ["Compile coverage, not a timing table: `ok` "
+                          "means the kernel builds and runs under that "
+                          "mesh shape; `mode=interpret` rows exercise "
+                          "the Pallas interpreter, ~40-80× slower than "
+                          "the compiled kernel.", ""]
+            lines += [_md_table(rows)]
     smoke = os.path.join(results_dir, "smoke_tpu.txt")
     if os.path.isfile(smoke):
         with open(smoke) as f:

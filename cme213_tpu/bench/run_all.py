@@ -67,15 +67,22 @@ def main(argv=None) -> int:
              size=32 if q else 2000, order=2 if q else 8,
              iters=3 if q else 100,
              ndevs=(1, 2) if q else (1, 2, 4, 8),
-             # always carry the tuned-kernel scheme: compiled on TPU,
-             # interpret-mode (slow, labeled in REPORT.md) on the CPU
-             # stand-in — so the committed CSV keeps its pallas rows
-             # however it is regenerated
-             pallas=True)),
+             # tuned-kernel scheme only where it is a real timing (TPU,
+             # compiled); interpreter rows live in the compile-coverage
+             # artifact below, not in this timing table
+             pallas=None)),
+        ("dist_heat_compile_coverage.csv",
+         lambda: sweeps.dist_heat_compile_coverage(
+             size=32 if q else 2000, order=2 if q else 8,
+             iters=2 if q else 4,
+             ndevs=(1, 2) if q else (1, 2, 4, 8))),
         ("sort_threads.csv",
          lambda: sweeps.sort_thread_sweep(
              num_elements=20_000 if q else 16_000_000,
              threads=(1, 2) if q else (1, 2, 4, 8, 16, 32))),
+        ("spmv_pallas_coverage.csv",
+         lambda: sweeps.spmv_pallas_coverage(
+             scale=0.002 if q else 1.0, iters=1)),
         ("spmv_suite.csv",
          lambda: sweeps.spmv_suite_sweep(
              scale=0.002 if q else 1.0,

@@ -489,8 +489,60 @@ def dist_heat_sweep(size: int = 256, order: int = 8, iters: int = 20,
                     "scheme": scheme,
                     "requested": requested,
                     "local_kernel": lk,
+                    # interpret-mode Pallas times the interpreter, not the
+                    # kernel — the column keeps any such row from being
+                    # read as a timing (off-TPU captures should prefer
+                    # dist_heat_compile_coverage for the pallas scheme)
+                    "mode": ("interpret" if lk == "pallas"
+                             and jax.devices()[0].platform != "tpu"
+                             else "compiled"),
                     "seconds": round(secs, 4),
                 })
+    return rows
+
+
+def dist_heat_compile_coverage(size: int = 2000, order: int = 8,
+                               iters: int = 4,
+                               ndevs=(1, 2, 4, 8)) -> list[dict]:
+    """Compile-coverage matrix for the tuned per-shard Pallas scheme under
+    every mesh shape — NOT a timing table.
+
+    Off-TPU the per-shard kernel runs in the Pallas interpreter, 40-80×
+    slower than the compiled kernel, so "does it build and run under this
+    mesh shape" evidence lives here (few iterations, ``ok`` column)
+    instead of inside the ``dist_heat_scaling`` timing CSV where an
+    interpreter row reads like a 40× regression.
+    """
+    import jax
+
+    from ..config import GridMethod, SimParams
+    from ..dist import mesh_for_method, prepare_distributed_heat
+
+    mode = ("compiled" if jax.devices()[0].platform == "tpu"
+            else "interpret")
+    rows = []
+    for nd in ndevs:
+        if nd > len(jax.devices()):
+            continue
+        for method in (GridMethod.STRIPES_1D, GridMethod.BLOCKS_2D):
+            p = SimParams(nx=size, ny=size, order=order, iters=iters)
+            mesh = mesh_for_method(method, nd)
+            try:
+                iterate, _, used_k = prepare_distributed_heat(
+                    p, mesh, overlap=False, steps_per_exchange=4,
+                    local_kernel="pallas")
+                iterate()
+                ok, err = True, ""
+                scheme = f"ca-k{used_k}" if used_k > 1 else "sync"
+            except Exception as e:  # noqa: BLE001 — coverage, not timing
+                _raise_if_device_error(e)
+                ok, err, scheme = False, f"{type(e).__name__}: {e}", ""
+            rows.append({
+                "devices": nd,
+                "method": "1D" if method == GridMethod.STRIPES_1D else "2D",
+                "scheme": scheme, "local_kernel": "pallas", "mode": mode,
+                "iters": iters, "ok": ok, "error": err,
+            })
     return rows
 
 
@@ -531,6 +583,56 @@ def scan_sweep(n: int = 1 << 26, num_segments: int = 1 << 16) -> list[dict]:
     return rows
 
 
+def spmv_pallas_coverage(names=None, scale: float = 1.0,
+                         iters: int = 1) -> list[dict]:
+    """Shape-coverage rehearsal for the Pallas segmented-scan kernel at
+    full suite sizes — NOT a timing table.
+
+    The kernel's first timed suite run must not be its first run at suite
+    shapes (round-4 review finding: its tests cover small shapes only).
+    Off-TPU this exercises every instance's padded tile geometry through
+    the Pallas interpreter and checks the output against the flat-XLA
+    kernel; on TPU the same rows double as a cheap per-shape compile
+    check before device minutes are spent on the timed suite.
+    """
+    import dataclasses
+
+    import jax
+
+    from ..apps import spmv_scan as sp
+    from ..apps.matrix_market import real_instance_specs
+
+    mode = ("compiled" if jax.devices()[0].platform == "tpu"
+            else "interpret")
+    specs = [(n, "synthetic", None)
+             for n in (names or sp.BELL_GARLAND_SUITE)]
+    if names is None:
+        specs.extend(real_instance_specs())
+    rows = []
+    for name, source, factory in specs:
+        prob = (sp.suite_problem(name, scale=scale) if factory is None
+                else factory())
+        prob = dataclasses.replace(prob, iters=iters)
+        rel = None
+        try:
+            out_pallas = sp.run_spmv_scan(prob, kernel="pallas")
+            out_flat = sp.run_spmv_scan(prob, kernel="flat")
+            rel = float(np.linalg.norm(out_pallas - out_flat)
+                        / max(np.linalg.norm(out_flat), 1e-30))
+            ok, err = bool(rel < 1e-4), ""
+        except Exception as e:  # noqa: BLE001 — coverage, not timing
+            _raise_if_device_error(e)
+            ok, err = False, f"{type(e).__name__}: {e}"
+        rows.append({
+            "matrix": name, "source": source, "n": prob.n, "p": prob.p,
+            "mode": mode, "iters": iters, "ok": ok,
+            "rel_l2_vs_flat": f"{rel:.2e}" if rel is not None else "",
+            "error": err,
+        })
+        print(rows[-1])
+    return rows
+
+
 def spmv_suite_sweep(names=None, scale: float = 0.05,
                      kernels=None, cpu_threads: int | None = 4) -> list[dict]:
     """Device kernels vs the OpenMP CPU reference over the suite.
@@ -552,22 +654,20 @@ def spmv_suite_sweep(names=None, scale: float = 0.05,
                    if jax.devices()[0].platform == "tpu" else ("flat",))
 
     rows = []
-    specs = [(n, "synthetic") for n in (names or sp.BELL_GARLAND_SUITE)]
-    # on the full default suite, the shipped real-matrix instance
-    # (HB/gr_30_30 reconstruction) rides the same sweep so the table has
-    # a row whose source is a real published problem, not a suite-shaped
-    # synthetic; an explicit names subset stays exactly that subset
-    import os
-
-    from ..apps.matrix_market import gr_30_30_path, problem_from_mtx
-    mtx = gr_30_30_path()
-    if names is None and os.path.exists(mtx):
-        specs.append(("gr_30_30", "real (HB/gr_30_30, reconstructed)"))
-    for name, source in specs:
+    specs = [(n, "synthetic", None)
+             for n in (names or sp.BELL_GARLAND_SUITE)]
+    # on the full default suite, the shipped/reconstructed real-matrix
+    # instances (HB/gr_30_30, Williams/dense2) ride the same sweep so the
+    # table has rows whose source is a real published problem, not a
+    # suite-shaped synthetic; an explicit names subset stays that subset
+    from ..apps.matrix_market import real_instance_specs
+    if names is None:
+        specs.extend(real_instance_specs())
+    for name, source, factory in specs:
         if source == "synthetic":
             prob = sp.suite_problem(name, scale=scale)
         else:
-            prob = problem_from_mtx(mtx, iters=50, seed=0)
+            prob = factory()
         cpu_ms = None
         if cpu_threads is not None:
             prev = native.thread_count()

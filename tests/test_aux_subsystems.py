@@ -89,6 +89,40 @@ def test_gr_30_30_real_matrix_end_to_end():
     assert errs["rel_l2"] < 1e-4, errs
 
 
+def test_dense2_reconstruction():
+    """VERDICT r4 item 5: the dense 2000×2000 suite instance is fully
+    pattern-determined — the reconstruction must carry exactly the
+    published shape (4,000,000 stored entries over 2000 rows) through the
+    readMM construction, and its engine output must pass the f64 check."""
+    import numpy as np
+
+    from cme213_tpu.apps import spmv_scan as sp
+    from cme213_tpu.apps.matrix_market import dense2_problem
+
+    prob = dense2_problem(iters=2, seed=0)
+    assert prob.n == 2000 * 2000
+    assert prob.q == 2000
+    assert np.all(prob.a == 1.0)
+    # run only a couple of iterations: the value here is that the real
+    # 4M-element instance goes end-to-end, not the timing
+    out = sp.run_spmv_scan(prob)
+    errs = sp.external_check(prob, out)
+    assert errs["rel_l2"] < 1e-4, errs
+
+
+def test_real_instance_specs_registry():
+    """Both reconstructions ride the suite sweep: names, source labels,
+    and working factories."""
+    from cme213_tpu.apps.matrix_market import real_instance_specs
+
+    specs = real_instance_specs()
+    by_name = {name: (source, factory) for name, source, factory in specs}
+    assert set(by_name) == {"gr_30_30", "dense2"}
+    for name, (source, factory) in by_name.items():
+        assert source.startswith("real ("), (name, source)
+        assert "reconstructed" in source
+
+
 def test_matrix_market_symmetric(tmp_path):
     from cme213_tpu.apps.matrix_market import read_matrix_market
 
