@@ -103,7 +103,8 @@ def make_english_corpus(n_bytes: int = 1_250_000, seed: int = 0,
         return w
 
     out: list[str] = []
-    size = 0
+    size = 1  # the trailing newline; kept exact so the >= n_bytes
+    # guarantee holds even when the loop exits right at the boundary
     while size < n_bytes:
         para_sents = int(rng.integers(3, 8))
         para: list[str] = []
@@ -118,7 +119,9 @@ def make_english_corpus(n_bytes: int = 1_250_000, seed: int = 0,
             para.append(" ".join(toks) + ".")
         text = _wrap(" ".join(para), line_width)
         out.append(text)
-        size += len(text) + 2
+        # "\n\n" separators join paragraphs, so only non-first paragraphs
+        # carry the extra 2 bytes — size tracks the emitted length exactly
+        size += len(text) + (2 if len(out) > 1 else 0)
     return ("\n\n".join(out) + "\n").encode("ascii")
 
 

@@ -89,3 +89,13 @@ def test_generator_never_short():
         data = make_english_corpus(n, seed)
         assert len(data) >= n, (n, seed, len(data))
         assert data.decode("ascii")  # stays pure ASCII
+
+
+def test_generator_exact_boundary():
+    """Requesting exactly an achievable output length must not come up a
+    byte short: same seed re-emits the same paragraphs, so asking for the
+    previous output's exact length exercises the size==n_bytes exit."""
+    for n, seed in [(500, 0), (5_000, 11), (10_000, 3)]:
+        m = len(make_english_corpus(n, seed))
+        data = make_english_corpus(m, seed)
+        assert len(data) >= m, (n, seed, m, len(data))
