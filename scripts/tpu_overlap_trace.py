@@ -8,7 +8,9 @@ and wraps one async run in ``core.trace.device_trace`` so the XPlane
 trace shows whether the ppermute halo exchange and the interior compute
 actually overlap.
 
-usage: tpu_overlap_trace.py [outdir]
+usage: tpu_overlap_trace.py [outdir] [--size=N] [--order=K] [--iters=I]
+(the flags exist so tests can drive the script end-to-end at toy sizes;
+the capture runs the defaults)
 
 Writes ``<outdir>/overlap_sync_vs_async.csv`` and an XPlane trace under
 ``<outdir>/xplane_overlap/``.  One TPU client at a time — run only from
@@ -33,9 +35,14 @@ from cme213_tpu.dist import (mesh_for_method,  # noqa: E402
 
 
 def main() -> int:
-    out = sys.argv[1] if len(sys.argv) > 1 else "bench_results"
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    opts = dict(a[2:].split("=", 1) for a in sys.argv[1:]
+                if a.startswith("--"))
+    out = args[0] if args else "bench_results"
     os.makedirs(out, exist_ok=True)
-    size, order, iters = 2000, 8, 100
+    size = int(opts.get("size", 2000))
+    order = int(opts.get("order", 8))
+    iters = int(opts.get("iters", 100))
     nd = len(jax.devices())
     mesh = mesh_for_method(GridMethod.STRIPES_1D, nd)
     print(f"devices={nd} platform={jax.devices()[0].platform}")

@@ -139,6 +139,35 @@ def test_matrix_market_symmetric(tmp_path):
     assert (7.0 == vals).sum() == 2
 
 
+def test_overlap_trace_script_end_to_end(tmp_path):
+    """The P11 profile-evidence capture stage, driven at toy size on the
+    8-device CPU mesh: wall-clock rows for sync/async/CA plus a real
+    XPlane file, and the CSV written only after the trace landed (a drop
+    mid-trace must leave no CSV, so the capture retries the whole step)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    out = tmp_path / "cap"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "tpu_overlap_trace.py"),
+         str(out), "--size=64", "--order=2", "--iters=8"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    csv_path = out / "overlap_sync_vs_async.csv"
+    assert csv_path.exists()
+    content = csv_path.read_text()
+    for scheme in ("sync", "async", "ca-k4"):
+        assert scheme in content, content
+    xplanes = [f for r, _, fs in os.walk(out / "xplane_overlap")
+               for f in fs if f.endswith(".xplane.pb")]
+    assert xplanes, "no XPlane file written"
+
+
 def test_vigenere_table_printers(capsys):
     import jax.numpy as jnp
 
