@@ -15,6 +15,20 @@ DEADLINE="${2:-28800}"
 cd "$(dirname "$0")/.."
 . scripts/capture_lib.sh
 start=$(date +%s)
+
+# The deadline is a HARD chip-release guarantee, not just a stop-polling
+# gate: the round driver runs its own bench on the real TPU at round end,
+# and a capture attempt still holding the chip then would make the
+# driver's preflight fail with the tunnel perfectly healthy.  Every
+# stage's timeout is therefore capped by the time remaining.
+remaining() {
+  echo $(( (start + DEADLINE) - $(date +%s) ))
+}
+capped() {  # $1 = nominal stage timeout
+  r=$(remaining)
+  [ "$r" -lt 1 ] && r=1
+  [ "$r" -lt "$1" ] && echo "$r" || echo "$1"
+}
 log=/tmp/tpu_autocapture.log
 bisected=0
 bisect_tries=0
@@ -58,14 +72,14 @@ while true; do
   # headline when a COMPLETE bench_f32.json already exists from a prior
   # attempt; tranche rows are never copied into it.
   echo "== tranche 1 (first-window bank) ==" >> "$log"
-  if timeout 2700 bash scripts/tpu_tranche1.sh bench_results \
+  if timeout "$(capped 2700)" bash scripts/tpu_tranche1.sh bench_results \
       >> "$log" 2>&1; then
     # committed device evidence exists from here on
     touch /tmp/tpu_evidence_done
     mkdir -p bench_results
     echo "== full capture ==" >> "$log"
-    if SKIP_F32=1 timeout 14000 bash scripts/tpu_capture.sh bench_results \
-        >> "$log" 2>&1; then
+    if SKIP_F32=1 timeout "$(capped 14000)" \
+        bash scripts/tpu_capture.sh bench_results >> "$log" 2>&1; then
       # full-capture evidence is on disk too (the marker was already set
       # after tranche 1; the session must still NOT start a tuning client
       # — the watcher owns the chip for the bisect below;
@@ -76,7 +90,7 @@ while true; do
       if [ "$bisected" = 0 ] && [ "$bisect_tries" -lt 3 ]; then
         bisect_tries=$((bisect_tries + 1))
         echo "== bisect (diagnostics, try $bisect_tries) ==" >> "$log"
-        timeout 3600 python scripts/tpu_pipeline_bisect.py \
+        timeout "$(capped 3600)" python scripts/tpu_pipeline_bisect.py \
           > /tmp/tpu_bisect_last.txt 2>&1
         rc=$?
         cat /tmp/tpu_bisect_last.txt >> "$log"
