@@ -21,7 +21,7 @@ of the Stanford CME213 (Spring 2012) parallel-workload suite (see SURVEY.md):
 - ``native``  — host-native C++/OpenMP components (hw4 sorts).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 # make JAX_PLATFORMS authoritative for every CLI/driver in this package
 # (this environment's sitecustomize otherwise overrides it; a wedged TPU
