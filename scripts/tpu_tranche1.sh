@@ -37,13 +37,32 @@ for k in xla pipeline-k4; do
     continue
   fi
   echo "-- tranche1 $k"
-  timeout 900 python bench.py --run-measurement --kernel="$k" \
-      > "$f.tmp" 2>>"$OUT/tranche1.stderr.log"
-  # child stdout is one JSON row; preflight failure leaves no stdout
+  # the pipeline row pins tile_y=128: the VMEM budget predicts it safe at
+  # headline width, whereas tile 256 is the known compile-crash risk —
+  # and a compiler crash kills the child before its own tile ladder can
+  # fall back.  The full bench/pipeline_tune sweeps still explore 256.
+  tile_env=""
+  [ "$k" = "pipeline-k4" ] && tile_env="BENCH_TILE_Y=128"
+  env $tile_env timeout 900 python bench.py --run-measurement \
+      --kernel="$k" > "$f.tmp" 2>>"$OUT/tranche1.stderr.log"
+  rc=$?
+  # child stdout is one JSON row; no row means the process died before
+  # reporting.  Classify by exit code: preflight watchdog (42) and
+  # timeout kill (124) are device-shaped and retried next window; any
+  # other silent death (compiler-helper crash, OOM kill) is recorded as
+  # a sticky result so the watcher doesn't re-crash it every window.
   grep '^{' "$f.tmp" | tail -n 1 > "$f" || true
   rm -f "$f.tmp"
-  [ -s "$f" ] || echo '{"kernel": "'"$k"'", "ok": false, "error": ' \
-    '"preflight: device unreachable"}' > "$f"
+  if [ ! -s "$f" ]; then
+    if [ "$rc" = 42 ] || [ "$rc" = 124 ]; then
+      echo '{"kernel": "'"$k"'", "ok": false,' \
+        '"error": "preflight: device unreachable (rc='"$rc"')"}' > "$f"
+    else
+      echo '{"kernel": "'"$k"'", "ok": false,' \
+        '"error": "child exit '"$rc"' with no row (compiler crash?)"}' \
+        > "$f"
+    fi
+  fi
   cat "$f"
 done
 
