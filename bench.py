@@ -32,11 +32,14 @@ _PREFLIGHT_EXIT = 42
 
 # candidate kernel names; each runs in its own child process
 # ordered by expected value: the safe baseline first (a number on the
-# board), then the likely winners (temporal-blocking pipelines), then the
-# comparison rows; xla-conv LAST — its ~200×-slower iterations are the
+# board), then pipeline-k4 — the kernel tranche-1 PROVED on device
+# (251.8 GB/s, 10.5× baseline) — before the unproven deeper-unroll
+# variants: round-5's first full-bench window died inside pipeline-k8's
+# cold compile (15 min, then the tunnel dropped), so the proven winner
+# banks first; xla-conv LAST — its ~200×-slower iterations are the
 # kernel that blew the round-2 window and it is strictly diagnostic
-KERNELS = ("xla", "pipeline-k8", "pipeline-k4", "pipeline2d-k8",
-           "xla-roll-k8", "pipeline-k1", "pipeline-k2", "pipeline2d-k1",
+KERNELS = ("xla", "pipeline-k4", "pipeline-k2", "pipeline-k8",
+           "pipeline2d-k8", "xla-roll-k8", "pipeline-k1", "pipeline2d-k1",
            "xla-roll", "xla-conv")
 _EXEC_CAP_S = 30.0
 _MAX_ITERS = 400
@@ -260,8 +263,12 @@ def run_children(dtype_name: str, budget_s: float = 2700.0) -> list[dict]:
                      f"--kernel={name}", f"--dtype={dtype_name}"],
                     timeout=900, capture_output=True, text=True)
             except subprocess.TimeoutExpired:
+                # no retry: with no result in 900 s the second cold attempt
+                # would do the same compile again and time out the same way
+                # (the persistent compile cache only helps once a compile
+                # has ever FINISHED); move on and keep the window
                 row = {"kernel": name, "ok": False, "error": "timeout (900s)"}
-                continue
+                break
             sys.stderr.write(proc.stderr)
             lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
             if lines:

@@ -27,5 +27,9 @@ __version__ = "0.5.0"
 # (this environment's sitecustomize otherwise overrides it; a wedged TPU
 # tunnel would then hang runs that explicitly asked for CPU)
 from .core.platform import apply_platform_env as _apply_platform_env
+from .core.platform import enable_compile_cache as _enable_compile_cache
 
 _apply_platform_env()
+# TPU compiles survive process restarts and tunnel windows (see
+# core/platform.enable_compile_cache); explicit-CPU runs skip it
+_enable_compile_cache()

@@ -40,6 +40,40 @@ def apply_platform_env() -> None:
         jax.config.update("jax_platforms", plat)
 
 
+def enable_compile_cache(path: str | None = None) -> None:
+    """Persistent XLA compilation cache across processes and windows.
+
+    The bench runs every kernel in its own child process, and the capture
+    watcher re-runs the whole sequence across tunnel windows — without a
+    persistent cache each retry pays the full device compile again (the
+    Pallas pipeline kernels take minutes at 4000²; round-5 saw a 15-minute
+    window consumed by one cold compile).  With the cache, a kernel
+    compiled in any earlier window or child loads back in milliseconds.
+
+    Enabled for TPU runs only: explicit-CPU runs (tests, fake-mesh
+    rehearsals) are compile-cheap and would just churn the cache dir.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return
+    import jax
+
+    cache_dir = path or os.environ.get(
+        "CME213_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            ".jax_compile_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # older jax without these flags — cache optional,
+        # but a silent miss re-opens the cold-compile-per-window cost, so say so
+        import sys
+
+        print(f"warning: persistent compile cache disabled ({e})",
+              file=sys.stderr)
+
+
 def device_preflight(seconds: float = 90.0) -> bool:
     """True iff a trivial device op completes within ``seconds``.
 
@@ -84,6 +118,15 @@ def force_cpu_devices(n_devices: int) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    try:
+        # CPU test/rehearsal compiles are cheap; don't churn the TPU
+        # compile cache (enabled at package import) with their entries
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception as e:
+        import sys
+
+        print(f"warning: could not disable compile cache for CPU run ({e})",
+              file=sys.stderr)
 
     devs = jax.devices()
     if devs[0].platform != "cpu" or len(devs) < n_devices:
