@@ -215,3 +215,38 @@ def test_heat_kernel_sweep_quick():
                      "pipeline2d-k2", "pipeline-k4", "pipeline2d-k4",
                      "pallas-k2", "pallas-k4"]
     assert all(r["error"] == "" and r["ms"] > 0 for r in rows)
+
+
+def test_compile_cache_gating():
+    """The persistent compile cache engages for TPU-path processes and
+    stays out of explicit-CPU ones (tests, workers, rehearsals)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    snippet = (f"import sys; sys.path.insert(0, {repo!r});"
+               "import cme213_tpu, jax;"
+               "print('DIR=', jax.config.jax_compilation_cache_dir)")
+    # explicit-CPU process: gate must keep the cache off
+    out = subprocess.run(
+        [sys.executable, "-c", snippet],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=120)
+    assert "DIR= None" in out.stdout, out.stderr
+    # TPU-path process (no platform override): cache dir configured;
+    # reading jax.config does not create a device client, so this is
+    # safe even while a capture owns the chip
+    env = {**os.environ, "CME213_COMPILE_CACHE": "/tmp/cc_t"}
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run([sys.executable, "-c", snippet], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert "DIR= /tmp/cc_t" in out.stdout, out.stderr
+
+
+def test_force_cpu_devices_disables_cache():
+    """In-process: conftest's force_cpu_devices must have reset the
+    cache dir so CPU test compiles don't churn the TPU cache."""
+    import jax
+
+    assert jax.config.jax_compilation_cache_dir is None
