@@ -87,17 +87,24 @@ def device_preflight(seconds: float = 90.0) -> bool:
     import threading
 
     done = threading.Event()
+    ok = [False]
 
     def probe():
-        apply_platform_env()
-        import jax
-        import jax.numpy as jnp
+        # done.set() in finally: a backend that ERRORS instantly (bad
+        # platform name, refused connection) reports False immediately
+        # instead of burning the whole budget; only a true hang waits it
+        try:
+            apply_platform_env()
+            import jax
+            import jax.numpy as jnp
 
-        (jnp.ones((8, 8)) * 2).block_until_ready()
-        done.set()
+            (jnp.ones((8, 8)) * 2).block_until_ready()
+            ok[0] = True
+        finally:
+            done.set()
 
     threading.Thread(target=probe, daemon=True).start()
-    return done.wait(seconds)
+    return done.wait(seconds) and ok[0]
 
 
 def force_cpu_devices(n_devices: int) -> None:

@@ -15,6 +15,27 @@ spmv_pallas_coverage spmv_suite transfer_bandwidth \
 data_bandwidth_vector_length bandwidth_vs_avg_edges scan_bandwidth \
 dist_heat_scaling dist_heat_compile_coverage pallas_tile"
 
+device_up_quick() {  # tunnel answers a trivial op within ~85 s?
+  # Guards every sweep/stage start: a sweep launched against a dead
+  # tunnel hangs inside PJRT client creation until its own 2700-5400 s
+  # timeout — one dead pass through the sweep list would otherwise burn
+  # ~7 h of watcher deadline (observed round 5, 03:34 UTC drop).
+  # CAPTURE_PREFLIGHT_S shortens the probe for the shell unit tests.
+  # $1 = optional platform name the answering device must also report
+  # (e.g. 'tpu') — checked on the already-created client, so it is free.
+  local t req
+  t="${CAPTURE_PREFLIGHT_S:-85}"
+  req="${1:-}"
+  timeout $((t + 15)) python -c "
+from cme213_tpu.core.platform import device_preflight
+import sys
+ok = device_preflight($t)
+if ok and '$req':
+    import jax
+    ok = jax.devices()[0].platform == '$req'
+sys.exit(0 if ok else 1)" >/dev/null 2>&1
+}
+
 bench_ok() {  # $1 = bench json path: holds a real (non-zero) number?
   [ -s "$1" ] && grep -q '"unit": "GB/s"' "$1" \
     && ! grep -q 'DEVICE UNAVAILABLE' "$1"

@@ -20,13 +20,21 @@ OUT="${1:-bench_results}"
 mkdir -p "$OUT"
 
 echo "== preflight =="
-timeout 120 python -c "
-import jax, jax.numpy as jnp
-d = jax.devices()[0]
-assert d.platform == 'tpu', f'not a TPU: {d}'
-(jnp.ones((8, 8)) * 2).block_until_ready()
-print('device:', d)
-" || { echo "preflight failed — tunnel down?"; exit 1; }
+# one probe implementation for the whole pipeline (capture_lib.sh);
+# 'tpu' additionally requires the answering device to BE a TPU
+device_up_quick tpu || { echo "preflight failed — tunnel down?"; exit 1; }
+echo "device: TPU answering"
+
+# per-pass cache of a DOWN verdict: a dead tunnel HANGS the probe for its
+# full budget (only an erroring backend fails fast), so the first failed
+# gate stamps every later stage instead of re-probing ~15 times
+TUNNEL_STATE=up
+gate_up() {
+  [ "$TUNNEL_STATE" = down ] && return 1
+  if device_up_quick; then TUNNEL_STATE=up; return 0; fi
+  TUNNEL_STATE=down
+  return 1
+}
 
 if [ "${SKIP_F32:-0}" = 1 ] && bench_complete "$OUT/bench_f32.json"; then
   echo "== headline bench (f32): using existing $OUT/bench_f32.json =="
@@ -50,6 +58,8 @@ if [ -s "$OUT/smoke_tpu.txt" ] \
         || { grep -q "FAILURES" "$OUT/smoke_tpu.txt" \
              && ! grep -qE "$DEVICE_ERR" "$OUT/smoke_tpu.txt"; }; }; then
   echo "== pallas smoke: already recorded =="
+elif ! gate_up; then
+  echo "pallas smoke: DEVICE DOWN (skipped this pass, retried next)"
 else
   echo "== pallas smoke (small shapes, recorded evidence) =="
   if timeout 1800 python scripts/tpu_smoke.py > "$OUT/smoke_tpu.txt" 2>&1
@@ -67,6 +77,13 @@ for sweep in $SWEEPS; do
         continue
     fi
     echo "-- $sweep"
+    # pre-stage gate: don't launch a multi-hour sweep at a dead tunnel
+    if ! gate_up; then
+        echo "preflight: device unreachable (pre-sweep gate)" \
+            > "$OUT/$sweep.failed"
+        echo "$sweep: DEVICE DOWN (recorded as retryable)"
+        continue
+    fi
     # the heavy sweeps compile tens of executables through the remote
     # helper (~20-40 s each cold); give them a longer leash
     case "$sweep" in
@@ -108,6 +125,10 @@ if [ -s "$OUT/overlap_sync_vs_async.csv" ] \
     echo "-- overlap trace: already captured"
 elif sweep_attempted "$OUT" "overlap_sync_vs_async"; then
     echo "-- overlap trace: sticky failure recorded, not retrying"
+elif ! gate_up; then
+    echo "preflight: device unreachable (pre-sweep gate)" \
+        > "$OUT/overlap_sync_vs_async.failed"
+    echo "overlap trace: DEVICE DOWN (recorded as retryable)"
 else
     echo "== overlap XPlane trace (P11 profile evidence) =="
     if timeout 2700 python scripts/tpu_overlap_trace.py "$OUT" \
@@ -125,6 +146,8 @@ fi
 f64csv="$OUT/heat_bandwidth_f64.csv"
 if [ -s "$f64csv" ]; then
     echo "-- f64 heat rows: already captured"
+elif ! gate_up; then
+    echo "f64 heat rows: DEVICE DOWN (skipped this pass, retried next)"
 else
     echo "== f64 heat rows (reference's double 4th-order axis) =="
     JAX_ENABLE_X64=1 timeout 2700 python - "$f64csv" <<'EOF'

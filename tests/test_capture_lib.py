@@ -147,3 +147,22 @@ def test_python_device_tags_subset_of_shell_classifier():
             pytest.fail(f"python classifier no longer raises on {tag!r}")
         assert re.search(pattern, f"xx {tag} yy"), (
             f"shell DEVICE_ERR does not match python tag {tag!r}")
+
+
+@pytest.mark.parametrize("platform,req,expect_rc", [
+    ("cpu", "", 0),       # a platform that answers -> gate passes
+    ("bogus9", "", 1),    # a platform that can't init -> gate fails closed
+    ("cpu", "tpu", 1),    # answers, but is not the required platform
+    ("cpu", "cpu", 0),    # answers and matches the required platform
+])
+def test_device_up_quick_gate(platform, req, expect_rc):
+    """The pre-sweep gate (device_up_quick) passes iff a trivial device
+    op completes (and the device matches the optional required platform)
+    — a dead backend must fail in ~CAPTURE_PREFLIGHT_S seconds, not hang
+    until the sweep's own multi-hour timeout."""
+    env = {**os.environ, "JAX_PLATFORMS": platform,
+           "CAPTURE_PREFLIGHT_S": "10"}
+    rc = subprocess.run(
+        ["bash", "-c", f'. "{LIB}"; device_up_quick "$1"', "_", req],
+        capture_output=True, env=env, timeout=90, cwd=REPO).returncode
+    assert rc == expect_rc
