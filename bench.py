@@ -298,6 +298,33 @@ def run_children(dtype_name: str, budget_s: float = 2700.0) -> list[dict]:
     return rows
 
 
+def _banked_rows() -> list[dict]:
+    """Committed device measurements from earlier tunnel windows.
+
+    NOT live numbers — each row is tagged with the evidence file it was
+    committed to (tranche-1 first-window bank, or a prior full-bench
+    capture) so the reader can tell banked from measured-now.
+    """
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = []
+    results = os.path.join(here, "bench_results")
+    try:
+        names = sorted(os.listdir(results))
+    except OSError:
+        return out
+    for fname in names:
+        if not (fname.startswith("tranche1_") and fname.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(results, fname)) as f:
+                row = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if row.get("ok") and row.get("platform") == "tpu":
+            out.append({"evidence": f"bench_results/{fname}", **row})
+    return out
+
+
 def main() -> None:
     if _CHILD_FLAG in sys.argv:
         kernel = next((a.split("=", 1)[1] for a in sys.argv
@@ -316,11 +343,15 @@ def main() -> None:
     ok = [r for r in rows if r.get("ok")]
     best = max(ok, key=lambda r: r["gbs"]) if ok else None
     if best is None:
+        # value stays 0 — no live measurement happened — but point at the
+        # committed device rows from earlier tunnel windows so a dead
+        # tunnel at capture time doesn't read as "never measured"
         print(json.dumps({
             "metric": f"heat2d stencil order-8 4000x4000 {dtype_name} "
                       "effective bandwidth (DEVICE UNAVAILABLE)",
             "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
             "kernels": rows,
+            "banked_device_rows": _banked_rows(),
         }))
         return
     print(json.dumps({
