@@ -166,3 +166,32 @@ def test_device_up_quick_gate(platform, req, expect_rc):
         ["bash", "-c", f'. "{LIB}"; device_up_quick "$1"', "_", req],
         capture_output=True, env=env, timeout=90, cwd=REPO).returncode
     assert rc == expect_rc
+
+
+CAPTURE = os.path.join(REPO, "scripts", "tpu_capture.sh")
+
+
+@pytest.mark.parametrize("old,new,expect", [
+    (PARTIAL_BENCH, GOOD_BENCH, "new"),    # more rows -> promote
+    (GOOD_BENCH, GOOD_BENCH, "new"),       # tie -> fresher wins
+    (PARTIAL_BENCH, DEAD_BENCH, "old"),    # regression -> keep banked rows
+    ("", DEAD_BENCH, "new"),               # nothing either way -> freshest
+    (None, GOOD_BENCH, "new"),             # first capture ever
+])
+def test_promote_bench(tmp_path, old, new, expect):
+    """A bench re-run must never replace a file holding more measured
+    device rows than the new attempt banked (a window dying before the
+    first kernel would otherwise erase earlier evidence)."""
+    f = tmp_path / "bench.json"
+    if old is not None:
+        f.write_text(old)
+    (tmp_path / "bench.json.new").write_text(new)
+    # extract promote_bench from the capture script and drive it directly
+    rc = subprocess.run(
+        ["bash", "-c",
+         f'. "{LIB}"; eval "$(sed -n \'/^promote_bench()/,/^}}/p\' '
+         f'"{CAPTURE}")"; promote_bench "$1"', "_", str(f)],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert rc.returncode == 0, rc.stderr
+    assert not (tmp_path / "bench.json.new").exists()
+    assert f.read_text() == (new if expect == "new" else old)
