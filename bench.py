@@ -100,10 +100,17 @@ def _pipeline_candidates(name: str, params, k: int, on_tpu: bool):
 
     order = params.order
     # BENCH_TILE_Y is a target; rounded to a valid multiple of the halo
-    # quantum so an arbitrary override can't trip the tile assert
-    target = int(os.environ.get("BENCH_TILE_Y", "256"))
+    # quantum so an arbitrary override can't trip the tile assert.
+    # Default ladder leads with the DEVICE-PROVEN tile: tranche-1
+    # (2026-07-31 01:06 UTC) showed tile 128 crashes Mosaic at k=4
+    # width 4000 while 64 compiles and hits 251.8 GB/s — and since this
+    # loop takes the first variant that calibrates, opening with a
+    # known-crashing tile costs minutes of window per bench re-run.
+    # Tile *exploration* (measure every tile, best wins) belongs to the
+    # pipeline_tune sweep, not the headline bench.
+    target = int(os.environ.get("BENCH_TILE_Y", "64"))
     tiles = []
-    for t in (target, 128, 64):
+    for t in (target, 64, 128):
         # width-aware: a tile whose double-buffered band would overflow
         # VMEM at this grid width is clamped before the compiler sees it
         ty = pick_pipeline_tile(params.gy, k, order, target=t,

@@ -37,12 +37,14 @@ for k in xla pipeline-k4; do
     continue
   fi
   echo "-- tranche1 $k"
-  # the pipeline row pins tile_y=128: the VMEM budget predicts it safe at
-  # headline width, whereas tile 256 is the known compile-crash risk —
-  # and a compiler crash kills the child before its own tile ladder can
-  # fall back.  The full bench/pipeline_tune sweeps still explore 256.
+  # the pipeline row pins tile_y=64 — the tile tranche-1 PROVED on
+  # device (tile 128 crashed Mosaic at k=4 width 4000 on 2026-07-31;
+  # 64 compiled and measured 251.8 GB/s).  A compiler crash kills the
+  # child before its own tile ladder can fall back, so the tranche must
+  # open with a tile that is known to compile; the pipeline_tune sweep
+  # still explores the larger tiles.
   tile_env=""
-  [ "$k" = "pipeline-k4" ] && tile_env="BENCH_TILE_Y=128"
+  [ "$k" = "pipeline-k4" ] && tile_env="BENCH_TILE_Y=64"
   env $tile_env timeout 900 python bench.py --run-measurement \
       --kernel="$k" > "$f.tmp" 2>>"$OUT/tranche1.stderr.log"
   rc=$?

@@ -6,6 +6,7 @@ when wrong, cf. BENCH_r02) are pinned without a device.
 """
 
 import json
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -156,15 +157,27 @@ def test_bisect_cell_parsing():
 
 
 def test_pipeline_candidate_tile_ladder():
-    """Pipeline children try descending tile sizes so one bad tile can't
-    zero out the kernel's row."""
+    """Pipeline children lead with the device-proven tile (64 — tile 128
+    crashed Mosaic at k=4 width 4000, tranche-1 2026-07-31) and only
+    then offer larger tiles; first-success-wins means a known-crashing
+    tile at the front would burn minutes of every window."""
     from cme213_tpu.config import SimParams
 
     params = SimParams(nx=4000, ny=4000, order=8, iters=8)
     variants = bench._pipeline_candidates("pipeline-k8", params, 8, True)
     labels = [l for l, _ in variants]
-    # the 256 target is VMEM-clamped to 160 at W=4096 (k=8) so the
-    # compiler is never offered the 17 MiB band that crashed round 3
-    assert labels == ["tile_y=160", "tile_y=128", "tile_y=64"]
+    assert labels == ["tile_y=64", "tile_y=128"]
+    # an explicit larger target is still honored (VMEM-clamped), placed
+    # first, with the proven tile as fallback
+    os.environ["BENCH_TILE_Y"] = "256"
+    try:
+        variants = bench._pipeline_candidates("pipeline-k8", params, 8,
+                                              True)
+        labels = [l for l, _ in variants]
+        # the 256 target is VMEM-clamped to 160 at W=4096 (k=8) so the
+        # compiler is never offered the 17 MiB band that crashed round 3
+        assert labels == ["tile_y=160", "tile_y=64", "tile_y=128"]
+    finally:
+        del os.environ["BENCH_TILE_Y"]
     variants2d = bench._pipeline_candidates("pipeline2d-k1", params, 1, True)
     assert all("tile_x=512" in l for l, _ in variants2d)
