@@ -17,6 +17,10 @@ the tunnel's RPC deadline and every later kernel inherited a dead client).
 Execution length is self-limiting: each child first times a short run, then
 sizes the timed iteration count so a single device execution stays well
 under the tunnel's RPC deadline.
+
+``--spmv`` instead prints the iterated SpMV-scan engine row (flat vs
+blocked vs Pallas-fused kernels, ``cme213_tpu.bench.sweeps.
+spmv_scan_sweep``) as one JSON line of the same shape.
 """
 
 import json
@@ -87,12 +91,16 @@ def _make_candidate(name: str, params, on_tpu: bool):
 
 
 def _pipeline_candidates(name: str, params, k: int, on_tpu: bool):
-    """(label, fn) variants for a pipeline kernel, largest tile first.
+    """(label, fn) variants for a pipeline kernel, proven tile first.
 
-    The remote compile helper is known to crash at some (width, tile)
-    combinations; the child tries tiles in descending order and measures
-    the first that calibrates, so an unattended bench run still records a
-    tuned-kernel number instead of one error row per kernel.
+    The ladder opens with the DEVICE-PROVEN tile (BENCH_TILE_Y, default
+    64 — the tile tranche-1 measured at 251.8 GB/s while 128 crashed
+    Mosaic) and only then offers the larger tiles.  The remote compile
+    helper is known to crash at some (width, tile) combinations; the
+    child measures the first variant that calibrates, so an unattended
+    bench run still records a tuned-kernel number instead of one error
+    row per kernel — which is exactly why the ladder must NOT lead with
+    an unproven large tile.
     """
     from cme213_tpu.ops.stencil_pipeline import (pick_pipeline_tile,
                                                  run_heat_pipeline,
@@ -232,6 +240,7 @@ def measure_one(name: str, dtype_name: str) -> dict:
         "kernel": name, "ok": True, "iters": iters,
         "variant": variant_label,
         "platform": dev.platform,
+        "dtype": dtype_name,
         "ms_per_iter": round(per_iter * 1e3, 4),
         "gbs": round(bytes_per_iter / per_iter / 1e9, 2),
         "gflops": round(flops_per_point(order) * nx * ny / per_iter / 1e9, 2),
@@ -305,12 +314,15 @@ def run_children(dtype_name: str, budget_s: float = 2700.0) -> list[dict]:
     return rows
 
 
-def _banked_rows() -> list[dict]:
+def _banked_rows(dtype_name: str = "f32") -> list[dict]:
     """Committed device measurements from earlier tunnel windows.
 
     NOT live numbers — each row is tagged with the evidence file it was
     committed to (tranche-1 first-window bank, or a prior full-bench
-    capture) so the reader can tell banked from measured-now.
+    capture) so the reader can tell banked from measured-now.  Rows are
+    filtered to the requested bench dtype (pre-dtype-field rows were all
+    f32 captures, so a missing field reads as f32) — an f32 device number
+    must never surface as banked evidence in the f64 output (ADVICE r5).
     """
     here = os.path.dirname(os.path.abspath(__file__))
     out = []
@@ -327,12 +339,43 @@ def _banked_rows() -> list[dict]:
                 row = json.load(f)
         except (OSError, ValueError):
             continue
-        if row.get("ok") and row.get("platform") == "tpu":
+        if (row.get("ok") and row.get("platform") == "tpu"
+                and row.get("dtype", "f32") == dtype_name):
             out.append({"evidence": f"bench_results/{fname}", **row})
     return out
 
 
+def run_spmv_bench() -> None:
+    """``--spmv``: the iterated SpMV-scan engine row (ISSUE 1) — flat vs
+    blocked vs Pallas-fused effective bandwidth at the sweep's largest n,
+    printed as one JSON line like the headline heat metric.  Runs in-
+    process (the sweep already classifies per-kernel failures as rows)."""
+    _apply_platform_env()
+    from cme213_tpu.bench.sweeps import spmv_scan_sweep
+
+    rows = spmv_scan_sweep()
+    ok = [r for r in rows if not r.get("error") and r["gbs"] > 0]
+    if not ok:
+        print(json.dumps({
+            "metric": "spmv_scan iterated segmented-scan effective "
+                      "bandwidth (NO MEASUREMENT)",
+            "value": 0.0, "unit": "GB/s", "kernels": rows}))
+        return
+    n_max = max(r["n"] for r in ok)
+    best = max((r for r in ok if r["n"] == n_max), key=lambda r: r["gbs"])
+    print(json.dumps({
+        "metric": f"spmv_scan iterated segmented-scan effective bandwidth "
+                  f"at n={n_max} (best kernel: {best['kernel']})",
+        "value": best["gbs"], "unit": "GB/s",
+        "pct_hbm_peak": round(100 * best["gbs"] / HBM_PEAK_GBS, 1),
+        "kernels": rows,
+    }))
+
+
 def main() -> None:
+    if "--spmv" in sys.argv:
+        run_spmv_bench()
+        return
     if _CHILD_FLAG in sys.argv:
         kernel = next((a.split("=", 1)[1] for a in sys.argv
                        if a.startswith("--kernel=")), "xla")
@@ -358,7 +401,7 @@ def main() -> None:
                       "effective bandwidth (DEVICE UNAVAILABLE)",
             "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
             "kernels": rows,
-            "banked_device_rows": _banked_rows(),
+            "banked_device_rows": _banked_rows(dtype_name),
         }))
         return
     print(json.dumps({

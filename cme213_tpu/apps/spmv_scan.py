@@ -34,10 +34,15 @@ from ..core import PhaseTimer
 from ..ops.segmented import (
     head_flags_from_starts,
     segmented_scan,
+    segmented_scan_blocked,
+    segmented_scan_flat,
     validate_segments,
 )
 from ..verify import golden
 from ..verify.checkers import l2_distance, relative_l2_error, relative_linf_error
+
+#: kernel names accepted by ``run_spmv_scan`` / the CLI ``--kernel=`` flag
+KERNELS = ("auto", "flat", "blocked", "pallas", "pallas-fused", "dense")
 
 
 @dataclass
@@ -164,24 +169,72 @@ def generate_problem(n: int, p: int, q: int, iters: int | None = None,
 
 # ------------------------------------------------------------------ engine
 
-@partial(jax.jit, static_argnames=("iters",), donate_argnums=(0,))
-def _iterate(a, xx, flags, iters: int):
+# the whole N-iteration loop is ONE device-resident program: a single jit
+# entry whose fori_loop body fuses the multiply into the scan's first
+# sweep, with the value buffer donated so XLA double-buffers in place
+# instead of allocating a fresh array per iteration — no per-iteration
+# Python dispatch, no per-iteration HBM allocation
+_SCAN_KERNELS = {
+    "auto": segmented_scan,            # size-threshold dispatch
+    "flat": segmented_scan_flat,       # O(n·log n) log-sweep, bitwise-stable
+    "blocked": segmented_scan_blocked,  # O(n) 3-phase block decomposition
+}
+
+
+@partial(jax.jit, static_argnames=("iters", "scan"), donate_argnums=(0,))
+def _iterate(a, xx, flags, iters: int, scan: str = "auto"):
+    scan_fn = _SCAN_KERNELS[scan]
+
     def body(_, v):
-        return segmented_scan(v * xx, flags)
+        return scan_fn(v * xx, flags)
 
     return jax.lax.fori_loop(0, iters, body, a)
 
 
-def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
-                  dtype=jnp.float32, kernel: str = "flat") -> np.ndarray:
-    """Device pipeline (fp.cu:154-190): upload, N × (multiply + segmented
-    scan), download.  Prints the spec-mandated timing line
-    (Final.pdf §4.2 format, fp.cu:190).
+@partial(jax.jit, static_argnames=("iters", "interpret"), donate_argnums=(0,))
+def _iterate_pallas_unfused(a, xx, flags, iters: int, interpret: bool):
+    """Per-iteration Pallas scan with the multiply left to XLA — one extra
+    HBM round trip per iteration vs the fused kernel; kept as a bench
+    point isolating what the ``fused_multiply`` hook buys."""
+    from ..ops.segmented_pallas import segmented_scan_pallas
 
-    ``kernel``: "flat" = XLA log-sweep scan; "pallas" = single-HBM-pass
-    blockwise kernel with the multiply fused (``ops/segmented_pallas.py``);
-    "dense" = the per-segment dense-matrix strawman (the role the
-    reference kept ``fp_old.cu`` around for — O(p·max_seg_len) work).
+    def body(_, v):
+        return segmented_scan_pallas(v * xx, flags, interpret=interpret)
+
+    return jax.lax.fori_loop(0, iters, body, a)
+
+
+def bytes_moved(n: int, iters: int, elem: int = 4) -> int:
+    """Exact byte accounting for bandwidth reports, as instrumented in the
+    reference sweep harness (same discipline as ``apps/pagerank.py:
+    bytes_moved``): per iteration the single-pass form reads the value
+    vector, the gathered ``xx`` vector, and the int32 head flags, and
+    writes the value vector — ``(3·elem + 4)·n`` bytes.  Multi-sweep
+    kernels move more than this; quoting all kernels against the same
+    useful-byte count is what makes the GB/s column comparable (the
+    "effective bandwidth" convention of ``bench.py``)."""
+    per_iter = n * (3 * elem + 4)
+    return per_iter * iters
+
+
+def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
+                  dtype=jnp.float32, kernel: str = "auto") -> np.ndarray:
+    """Device pipeline (fp.cu:154-190): upload, N × (multiply + segmented
+    scan), download — the N iterations run as ONE jitted ``fori_loop``
+    with the value buffer donated, whatever the kernel.  Prints the
+    spec-mandated timing line (Final.pdf §4.2 format, fp.cu:190).
+
+    ``kernel``:
+
+    - "auto" (default): XLA path, flat log-sweep below
+      ``ops.BLOCKED_SCAN_THRESHOLD`` elements, blocked O(n) scan above;
+    - "flat"/"blocked": force the respective XLA scan;
+    - "pallas-fused": single-HBM-pass blockwise kernel with the multiply
+      fused into the scan's load (``ops/segmented_pallas.py``);
+    - "pallas": the same kernel per iteration but the multiply left to
+      XLA (isolates the fusion win);
+    - "dense": the per-segment dense-matrix strawman (the role the
+      reference kept ``fp_old.cu`` around for — O(p·max_seg_len) work).
     """
     import jax
 
@@ -190,14 +243,18 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
     xx = jnp.asarray(prob.xx, dtype)
     flags = head_flags_from_starts(jnp.asarray(prob.s[:-1]), prob.n)
     timer = timer or PhaseTimer()
-    if kernel == "pallas":
+    if kernel == "pallas-fused":
         from ..ops.segmented_pallas import spmv_scan_pallas
 
         interpret = jax.devices()[0].platform != "tpu"
         runner = lambda v: spmv_scan_pallas(v, xx, flags, prob.iters,
                                             interpret=interpret)
-    elif kernel == "flat":
-        runner = lambda v: _iterate(v, xx, flags, prob.iters)
+    elif kernel == "pallas":
+        interpret = jax.devices()[0].platform != "tpu"
+        runner = lambda v: _iterate_pallas_unfused(v, xx, flags, prob.iters,
+                                                   interpret=interpret)
+    elif kernel in _SCAN_KERNELS:
+        runner = lambda v: _iterate(v, xx, flags, prob.iters, scan=kernel)
     elif kernel == "dense":
         from ..ops.segmented import segmented_scan_dense
 
@@ -230,9 +287,12 @@ def run_spmv_scan_distributed(prob: Problem, mesh, dtype=jnp.float32,
                               timer: PhaseTimer | None = None) -> np.ndarray:
     """Mesh-parallel pipeline: the value sequence is sharded over the mesh's
     first axis and each iteration runs the multi-device segmented scan
-    (``dist/scan.py``) — the long-sequence scaling path.  Pads to a shard
-    multiple with zero-valued, own-segment tail elements (they never affect
-    real segments)."""
+    (``dist/scan.py``) — the long-sequence scaling path.  The per-shard
+    scan inherits the flat/blocked size dispatch, so per-shard work is
+    O(n/d) once shards cross the threshold.  Pads to a shard multiple
+    with zero-valued, own-segment tail elements (they never affect real
+    segments)."""
+    from ..dist.mesh import shard_map
     from ..dist.scan import _local_with_carry  # sharded kernel
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -265,9 +325,9 @@ def run_spmv_scan_distributed(prob: Problem, mesh, dtype=jnp.float32,
 
             return jax.lax.fori_loop(0, iters, body, a_blk)
 
-        return jax.shard_map(sharded, mesh=mesh,
-                             in_specs=(spec, spec, spec),
-                             out_specs=spec)(a_d, xx_d, fl_d)
+        return shard_map(sharded, mesh=mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec)(a_d, xx_d, fl_d)
 
     timer = timer or PhaseTimer()
     iterate(jnp.zeros_like(a_d), xx_d, fl_d, prob.iters).block_until_ready()
@@ -335,7 +395,8 @@ def main(argv: list[str]) -> int:
     """Driver CLI mirroring the reference's fp binary (fp.cu:74-216) plus a
     readMM-style ``gen`` subcommand:
 
-        spmv_scan a.txt x.txt [cpu_check] [--kernel=flat|pallas|dense]
+        spmv_scan a.txt x.txt [cpu_check]
+                  [--kernel=auto|flat|blocked|pallas|pallas-fused|dense]
                   [--distributed]
         spmv_scan gen a.txt x.txt [n p q [iters]] [--seed=S]
         spmv_scan mtx matrix.mtx [cpu_check] [--kernel=...] [--seed=S]
@@ -347,7 +408,7 @@ def main(argv: list[str]) -> int:
     (fp.cu:192-212).
     """
     args = [a for a in argv[1:] if not a.startswith("--")]
-    kernel = "flat"
+    kernel = "auto"
     seed = 0
     distributed = False
     for a in argv[1:]:
@@ -360,8 +421,8 @@ def main(argv: list[str]) -> int:
         elif a.startswith("--"):
             print(f"error: unknown option {a!r} (flags use --name=value)")
             return 2
-    if kernel not in ("flat", "pallas", "dense"):
-        print(f"error: unknown kernel {kernel!r} (flat|pallas|dense)")
+    if kernel not in KERNELS:
+        print(f"error: unknown kernel {kernel!r} ({'|'.join(KERNELS)})")
         return 2
 
     if args and args[0] == "gen":

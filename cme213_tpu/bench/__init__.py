@@ -6,6 +6,7 @@ from .sweeps import (
     scan_sweep,
     pallas_tile_sweep,
     sort_thread_sweep,
+    spmv_scan_sweep,
     spmv_suite_sweep,
     transfer_bandwidth_sweep,
     write_csv,
@@ -19,6 +20,7 @@ __all__ = [
     "heat_sweep",
     "pallas_tile_sweep",
     "sort_thread_sweep",
+    "spmv_scan_sweep",
     "spmv_suite_sweep",
     "transfer_bandwidth_sweep",
     "write_csv",

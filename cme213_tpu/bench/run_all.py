@@ -87,6 +87,11 @@ def main(argv=None) -> int:
          lambda: sweeps.spmv_suite_sweep(
              scale=0.002 if q else 1.0,
              kernels=("flat",) if q else None)),
+        ("spmv_scan_sweep.csv",
+         lambda: sweeps.spmv_scan_sweep(
+             ns=(1 << 12,) if q else (1 << 16, 1 << 20, 1 << 22),
+             iters=2 if q else 8,
+             kernels=("flat", "blocked") if q else None)),
     ]
     if only is not None:
         known = {f[:-len(".csv")] for f, _ in jobs}

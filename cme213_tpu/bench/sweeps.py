@@ -583,6 +583,53 @@ def scan_sweep(n: int = 1 << 26, num_segments: int = 1 << 16) -> list[dict]:
     return rows
 
 
+def spmv_scan_sweep(ns=(1 << 16, 1 << 20, 1 << 22), iters: int = 8,
+                    kernels=None, p_frac: float = 0.01) -> list[dict]:
+    """Effective bandwidth of the iterated SpMV-scan engine vs kernel and
+    problem size — the flat-vs-blocked-vs-fused comparison behind the
+    O(n) scan work (ISSUE 1).
+
+    Byte accounting is exact (``apps/spmv_scan.bytes_moved``): every
+    kernel is quoted against the single-pass useful-byte count, so the
+    GB/s column directly exposes the flat sweep's log2(n) extra traffic.
+    ``kernels=None`` picks all four on TPU but only the XLA pair
+    elsewhere — the Pallas kernels in interpret mode at multi-million n
+    would take hours (they still appear via ``spmv_pallas_coverage``).
+    """
+    import jax
+
+    from ..apps import spmv_scan as sp
+    from ..core import PhaseTimer
+
+    if kernels is None:
+        kernels = (("flat", "blocked", "pallas", "pallas-fused")
+                   if jax.devices()[0].platform == "tpu"
+                   else ("flat", "blocked"))
+    rows = []
+    for n in ns:
+        p = max(3, int(n * p_frac))
+        prob = sp.generate_problem(n, p, max(2, p - 1), iters=iters,
+                                   seed=n % 97)
+        nbytes = sp.bytes_moved(n, iters)
+        for kernel in kernels:
+            timer = PhaseTimer()
+            try:
+                out = sp.run_spmv_scan(prob, timer=timer, kernel=kernel)
+            except Exception as e:  # a kernel failing at a shape is data
+                _raise_if_device_error(e)
+                rows.append({"n": n, "p": p, "iters": iters,
+                             "kernel": kernel, "ms": -1.0, "gbs": 0.0,
+                             "rel_l2": "", "error": type(e).__name__})
+                continue
+            errs = sp.external_check(prob, out)
+            ms = timer.last_ms("spmv_scan")
+            rows.append({"n": n, "p": p, "iters": iters, "kernel": kernel,
+                         "ms": round(ms, 3),
+                         "gbs": round(nbytes / 1e9 / (ms / 1e3), 3),
+                         "rel_l2": f"{errs['rel_l2']:.2e}", "error": ""})
+    return rows
+
+
 def spmv_pallas_coverage(names=None, scale: float = 1.0,
                          iters: int = 1) -> list[dict]:
     """Shape-coverage rehearsal for the Pallas segmented-scan kernel at
@@ -615,7 +662,7 @@ def spmv_pallas_coverage(names=None, scale: float = 1.0,
         prob = dataclasses.replace(prob, iters=iters)
         rel = None
         try:
-            out_pallas = sp.run_spmv_scan(prob, kernel="pallas")
+            out_pallas = sp.run_spmv_scan(prob, kernel="pallas-fused")
             out_flat = sp.run_spmv_scan(prob, kernel="flat")
             rel = float(np.linalg.norm(out_pallas - out_flat)
                         / max(np.linalg.norm(out_flat), 1e-30))
@@ -640,8 +687,9 @@ def spmv_suite_sweep(names=None, scale: float = 0.05,
     ``cpu_threads`` adds the reference's CPU measurement axis (4-thread
     table, ``hw/hw_final/programming/data.ods`` table 2 / ``fp.cu:130-152``)
     as a ``cpu_ms`` column; ``None`` skips it.  ``kernels=None`` picks
-    ``("flat", "pallas")`` on TPU but ``("flat",)`` elsewhere — the Pallas
-    segmented kernel in interpret mode at suite scale would take hours.
+    ``("flat", "blocked", "pallas-fused")`` on TPU but ``("flat",)``
+    elsewhere — the Pallas segmented kernel in interpret mode at suite
+    scale would take hours.
     """
     import jax
 
@@ -650,7 +698,7 @@ def spmv_suite_sweep(names=None, scale: float = 0.05,
     from ..core import PhaseTimer
 
     if kernels is None:
-        kernels = (("flat", "pallas")
+        kernels = (("flat", "blocked", "pallas-fused")
                    if jax.devices()[0].platform == "tpu" else ("flat",))
 
     rows = []

@@ -44,6 +44,7 @@ from ..config import SimParams
 from ..grid import make_initial_grid, interior
 from ..ops.stencil import BORDER_FOR_ORDER, stencil_interior
 from .halo import pad_with_halos
+from .mesh import shard_map
 
 
 def _pad_axis0(block, axis_name, axis_size, border, lo_fill, hi_fill):
@@ -193,7 +194,7 @@ def distributed_heat_step(params: SimParams, mesh: Mesh, overlap: bool = False):
     local = _overlap_local_step if overlap else _sync_local_step
 
     def step(u):
-        return jax.shard_map(
+        return shard_map(
             lambda blk: local(blk, params, y_size, x_size),
             mesh=mesh, in_specs=(spec,), out_specs=spec,
         )(u)
@@ -229,9 +230,9 @@ def _run(u, params, mesh, iters, overlap, steps_per_exchange=1,
     # check_vma=False for the Pallas local kernel: varying-across-mesh
     # tracking through interpret-mode pallas_call trips a lowering-cache
     # bug, and the kernel neither uses collectives nor crosses shards
-    return jax.shard_map(sharded_loop, mesh=mesh,
-                         in_specs=(spec,), out_specs=spec,
-                         check_vma=local_kernel != "pallas")(u)
+    return shard_map(sharded_loop, mesh=mesh,
+                     in_specs=(spec,), out_specs=spec,
+                     check_vma=local_kernel != "pallas")(u)
 
 
 def prepare_distributed_heat(params: SimParams, mesh: Mesh,

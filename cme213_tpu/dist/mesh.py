@@ -24,6 +24,24 @@ from jax.sharding import Mesh
 from ..config import GridMethod
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across the jax version straddle.
+
+    ``jax.shard_map`` became a top-level API after 0.4.x; on 0.4.37 (this
+    environment) the implementation lives in ``jax.experimental.shard_map``
+    and spells the replication-check kwarg ``check_rep`` instead of
+    ``check_vma``.  All sharded entry points in this package route through
+    this wrapper so the straddle lives in exactly one place.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)
+
+
 def make_mesh_1d(num_devices: int | None = None, axis: str = "y",
                  devices=None) -> Mesh:
     """1-D stripe decomposition mesh (hw5 gridMethod=1)."""

@@ -32,6 +32,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.segmented import segmented_scan
+from .mesh import shard_map
 
 
 def _carry_gather(carry_v, carry_f, axis_name: str, axis_size: int):
@@ -108,7 +109,7 @@ def distributed_segmented_scan(values: jnp.ndarray, head_flags: jnp.ndarray,
     values = jax.device_put(values, sharding)
     head_flags = jax.device_put(head_flags.astype(jnp.int32), sharding)
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         partial(_local_with_carry, axis_name=axis_name, axis_size=axis_size,
                 carry_mode=carry_mode),
         mesh=mesh, in_specs=(spec, spec), out_specs=spec,

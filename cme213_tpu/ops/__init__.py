@@ -9,9 +9,12 @@ from .elementwise import (
 )
 from .scan import inclusive_scan, exclusive_scan, blocked_inclusive_scan
 from .segmented import (
+    BLOCKED_SCAN_THRESHOLD,
     head_flags_from_starts,
     segment_ids_from_starts,
     segmented_scan,
+    segmented_scan_blocked,
+    segmented_scan_flat,
     segmented_scan_from_starts,
     validate_segments,
 )
@@ -38,9 +41,12 @@ __all__ = [
     "inclusive_scan",
     "exclusive_scan",
     "blocked_inclusive_scan",
+    "BLOCKED_SCAN_THRESHOLD",
     "head_flags_from_starts",
     "segment_ids_from_starts",
     "segmented_scan",
+    "segmented_scan_blocked",
+    "segmented_scan_flat",
     "segmented_scan_from_starts",
     "validate_segments",
     "histogram_sort",

@@ -12,6 +12,13 @@ which is associative, so ``lax.associative_scan`` runs it in log depth fused
 by XLA across the whole array regardless of segment boundaries — replacing
 the reference's data-dependent per-segment loops with regular control flow.
 
+Two XLA forms live here, behind the size-dispatching ``segmented_scan``:
+the flat log-sweep (``segmented_scan_flat``, O(n·log n) work, bitwise-
+stable) and the blocked Blelloch/Sengupta 3-phase decomposition
+(``segmented_scan_blocked``, O(n) work per pass — per-block local scans →
+scan of block carries → broadcast-add, the same shape as
+``ops/scan.py:blocked_inclusive_scan`` and the mesh-scale ``dist/scan.py``).
+
 Segment descriptors match the reference's: ``s`` = sorted segment start
 indices with ``s[0] == 0`` (validated like ``load()``,
 ``hw/hw_final/programming/aux/mp1-util.h:81-169``); the precomputed
@@ -37,8 +44,22 @@ def segment_ids_from_starts(seg_starts: jnp.ndarray, n: int) -> jnp.ndarray:
     return jnp.cumsum(head_flags_from_starts(seg_starts, n)) - 1
 
 
-def segmented_scan(values: jnp.ndarray, head_flags: jnp.ndarray) -> jnp.ndarray:
-    """Inclusive segmented sum scan over (value, flag) pairs.
+# Auto-dispatch threshold: below this the flat log-sweep (bitwise-stable,
+# compile-cheap) runs; at/above it the blocked O(n) form wins — the flat
+# sweep moves n·log2(n) elements through HBM per scan while the blocked
+# form moves ~3n (local cumsum pass + tiny carry scan + broadcast-add).
+# 2^16 sits well under the 1M crossover the bench sweep demonstrates while
+# keeping every existing small-shape test on the bitwise flat path.
+BLOCKED_SCAN_THRESHOLD = 1 << 16
+# Per-block extent of the blocked decomposition.  Large enough that the
+# inter-block carry scan (n / BLOCK elements, still log-sweep) is noise,
+# small enough that a block's running cumsum stays cache/VMEM resident.
+DEFAULT_SCAN_BLOCK = 4096
+
+
+def segmented_scan_flat(values: jnp.ndarray,
+                        head_flags: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive segmented sum scan over (value, flag) pairs — flat form.
 
     Hillis-Steele log-depth sweep — the same doubling-stride recurrence the
     reference's ``scan_warp`` runs over a 31-element warp window
@@ -49,7 +70,8 @@ def segmented_scan(values: jnp.ndarray, head_flags: jnp.ndarray) -> jnp.ndarray:
         f[i] |= f[i-d]
 
     One traced body under ``fori_loop`` (stride computed from the loop index)
-    keeps compilation O(1) in n.
+    keeps compilation O(1) in n.  O(n·log n) work/traffic — preferred only
+    for small n (see ``segmented_scan`` for the dispatch).
     """
     n = values.shape[0]
     steps = max(1, (n - 1).bit_length())
@@ -67,6 +89,87 @@ def segmented_scan(values: jnp.ndarray, head_flags: jnp.ndarray) -> jnp.ndarray:
 
     out, _ = lax.fori_loop(0, steps, body, (values, head_flags.astype(jnp.int32)))
     return out
+
+
+def segmented_scan_blocked(values: jnp.ndarray, head_flags: jnp.ndarray,
+                           block_size: int = DEFAULT_SCAN_BLOCK) -> jnp.ndarray:
+    """Inclusive segmented sum scan — blocked O(n) form.
+
+    The Blelloch/Sengupta 3-phase decomposition (``my-refs/scan.pdf``;
+    SURVEY §2.7 P7/P8), mirroring ``blocked_inclusive_scan`` in
+    ``ops/scan.py`` with the segment-aware carry:
+
+    1. per-block LOCAL segmented scans, computed in O(block) as
+       ``cumsum(v) − cumsum[last head at or before i − 1]`` (reset-by-
+       subtraction — one cumsum pass plus one gather, no log sweep);
+    2. a segmented scan of the per-block open-segment carries
+       ``(last local value, block contains a head?)`` over the n/block
+       block summaries (flat log-sweep: negligible at that length);
+    3. broadcast-add of each block's incoming carry to its elements
+       before the block's first head.
+
+    Total work and HBM traffic are O(n) per pass, vs O(n·log n) for the
+    flat sweep.  Association differs from the flat form, so float results
+    agree to rounding, not ULP (the tolerance model documented in
+    ``ops/segmented_pallas.py``); on integer-valued inputs the two are
+    exact, hence bitwise-equal.
+
+    Pads internally to a block multiple (pad isolated in its own segment
+    and dropped on return).
+    """
+    n = values.shape[0]
+    flags = head_flags.astype(jnp.int32)
+    nblk = max(1, -(-n // block_size))
+    padded = nblk * block_size
+    if padded != n:
+        v = jnp.zeros((padded,), values.dtype).at[:n].set(values)
+        f = jnp.zeros((padded,), jnp.int32).at[:n].set(flags)
+        f = f.at[n].set(1)  # quarantine the pad in its own segment
+    else:
+        v, f = values, flags
+    v2 = v.reshape(nblk, block_size)
+    f2 = f.reshape(nblk, block_size)
+
+    # phase 1: local segmented scan per block, reset-by-subtraction
+    cs = jnp.cumsum(v2, axis=1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (nblk, block_size), 1)
+    # index of the last head at or before each position (−1: none yet)
+    hp = lax.cummax(jnp.where(f2 > 0, lane, -1), axis=1)
+    base = jnp.where(
+        hp >= 1,
+        jnp.take_along_axis(cs, jnp.maximum(hp - 1, 0), axis=1),
+        jnp.zeros_like(cs))
+    local = cs - base
+
+    # phase 2: segmented scan of block carries; the local scan already
+    # resets at heads, so the last element's value IS the running sum of
+    # the block's open segment (same invariant as the Pallas kernel's
+    # cross-tile carry and dist/scan.py's shard carry)
+    carry_v = local[:, -1]
+    carry_f = (hp[:, -1] >= 0).astype(jnp.int32)
+    inc_v = segmented_scan_flat(carry_v, carry_f)
+    # exclusive incoming carry for block b = inclusive through block b−1
+    incoming = jnp.concatenate([jnp.zeros((1,), inc_v.dtype), inc_v[:-1]])
+
+    # phase 3: add the carry to elements before each block's first head
+    no_head_yet = hp < 0
+    out = local + jnp.where(no_head_yet, incoming[:, None],
+                            jnp.zeros_like(local))
+    return out.reshape(padded)[:n]
+
+
+def segmented_scan(values: jnp.ndarray, head_flags: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive segmented sum scan — auto-dispatching entry point.
+
+    Small arrays (n < ``BLOCKED_SCAN_THRESHOLD``) run the flat log-sweep
+    (``segmented_scan_flat``, bitwise-stable with prior releases); larger
+    arrays run the blocked O(n) form (``segmented_scan_blocked``).  The
+    length is static under jit, so the dispatch costs nothing at trace
+    time and each shape compiles exactly one kernel.
+    """
+    if values.shape[0] >= BLOCKED_SCAN_THRESHOLD:
+        return segmented_scan_blocked(values, head_flags)
+    return segmented_scan_flat(values, head_flags)
 
 
 def segmented_scan_from_starts(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray:

@@ -420,14 +420,17 @@ def stencil_local_multistep(p: jnp.ndarray, gy0, gx0, ny: int, nx: int,
         out_specs=pl.BlockSpec((tile_y, Wp), lambda i, offs: (i, 0)),
     )
     # inside shard_map the output aval must carry the varying-across-mesh
-    # annotation; inherit it from the input block
+    # annotation; inherit it from the input block.  jax 0.4.x has neither
+    # jax.typeof nor a vma kwarg on ShapeDtypeStruct (its shard_map uses
+    # check_rep, with no per-aval annotation) — fall back to a plain struct.
     try:
         vma = jax.typeof(p).vma
-    except AttributeError:
-        vma = frozenset()
+        out_shape = jax.ShapeDtypeStruct((Hp, Wp), p.dtype, vma=vma)
+    except (AttributeError, TypeError):
+        out_shape = jax.ShapeDtypeStruct((Hp, Wp), p.dtype)
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((Hp, Wp), p.dtype, vma=vma),
+        out_shape=out_shape,
         grid_spec=grid_spec,
         interpret=interpret,
     )(offs, p, p, p)

@@ -11,7 +11,7 @@ DEVICE_ERR='UNAVAILABLE|unreachable|DEADLINE|preflight|device hang|device error'
 # cheaper, lower-stakes sweeps instead; transfer_bandwidth is usually
 # already banked by tranche 1 and skips instantly
 SWEEPS="heat_kernels pipeline_tune heat_bandwidth \
-spmv_pallas_coverage spmv_suite transfer_bandwidth \
+spmv_pallas_coverage spmv_suite spmv_scan_sweep transfer_bandwidth \
 data_bandwidth_vector_length bandwidth_vs_avg_edges scan_bandwidth \
 dist_heat_scaling dist_heat_compile_coverage pallas_tile"
 
@@ -55,6 +55,23 @@ sweep_attempted() {  # $1 = outdir, $2 = sweep: captured, or sticky-failed?
 
 row_ok() {  # $1 = per-kernel row json (bench.py child mode): real number?
   [ -s "$1" ] && grep -q '"ok": true' "$1"
+}
+
+count_measured_rows() {  # $1 = bench json: ok:true rows in the "kernels"
+  # array ONLY.  A DEVICE-UNAVAILABLE bench output carries the committed
+  # banked_device_rows (all ok:true by construction) for the reader; a
+  # whole-file grep would count those as live measurements and let a
+  # dead-tunnel re-run outvote a file holding real measured rows.
+  [ -s "$1" ] || { echo 0; return; }
+  python - "$1" <<'PY' 2>/dev/null || echo 0
+import json, sys
+try:
+    with open(sys.argv[1]) as f:
+        doc = json.load(f)
+    print(sum(1 for r in doc.get("kernels", []) if r.get("ok")))
+except Exception:
+    print(0)
+PY
 }
 
 row_conclusive() {  # $1: banked number, or a sticky (non-device) failure —

@@ -40,8 +40,12 @@ gate_up() {
 # number — a window that dies before the first kernel must not replace
 # an earlier partial that banked real rows (e.g. the 03:18 UTC xla row)
 promote_bench() {  # $1 = final json path (expects $1.new from the run)
-  new_ok=$(grep -o '"ok": true' "$1.new" 2>/dev/null | wc -l)
-  old_ok=$(grep -o '"ok": true' "$1" 2>/dev/null | wc -l)
+  # measured rows = ok:true INSIDE the kernels array only
+  # (capture_lib.count_measured_rows): a dead-tunnel re-run echoes the
+  # committed banked_device_rows, and counting those would let it
+  # replace a file holding live-measured rows
+  new_ok=$(count_measured_rows "$1.new")
+  old_ok=$(count_measured_rows "$1")
   if [ "$new_ok" -ge "$old_ok" ]; then
     mv "$1.new" "$1"   # at least as many measured rows (fresher wins ties)
   else

@@ -19,8 +19,16 @@ PARTIAL_BENCH = ('{"metric": "heat2d ...", "value": 14.6, "unit": "GB/s", '
                  '"kernels": [{"kernel": "xla", "ok": true}, '
                  '{"kernel": "pipeline-k8", "ok": false, '
                  '"error": "preflight: device unreachable"}]}\n')
+# a dead-window bench output echoes the COMMITTED banked_device_rows
+# (ok:true by construction) — promote_bench must not count those as
+# live-measured rows, or a dead re-run could replace real evidence
 DEAD_BENCH = ('{"metric": "heat2d ... (DEVICE UNAVAILABLE)", "value": 0.0, '
-              '"unit": "GB/s", "vs_baseline": 0.0}\n')
+              '"unit": "GB/s", "vs_baseline": 0.0, "kernels": ['
+              '{"kernel": "xla", "ok": false, '
+              '"error": "preflight: device unreachable"}], '
+              '"banked_device_rows": ['
+              '{"kernel": "xla", "ok": true, "gbs": 50.85}, '
+              '{"kernel": "pipeline-k4", "ok": true, "gbs": 251.8}]}\n')
 
 
 def _call(fn: str, *args: str) -> int:

@@ -1,0 +1,218 @@
+"""Blocked O(n) segmented scan (ISSUE 1 tentpole) — correctness across
+segment layouts, agreement with the flat log-sweep, and the size-threshold
+dispatch behind ``segmented_scan``.
+
+Tolerance model: the blocked form associates additions differently from
+the flat sweep (reset-by-subtraction within blocks + cross-block carries),
+so float results agree to rounding, not ULP — the model documented in
+``ops/segmented_pallas.py``.  On integer-valued inputs every partial sum
+is exact, so flat and blocked must agree BITWISE.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from cme213_tpu.ops.segmented import (
+    BLOCKED_SCAN_THRESHOLD,
+    head_flags_from_starts,
+    segmented_scan,
+    segmented_scan_blocked,
+    segmented_scan_flat,
+)
+from cme213_tpu.verify import golden
+
+
+def _run_blocked(v, s, block_size):
+    n = v.shape[0]
+    flags = head_flags_from_starts(jnp.asarray(s, jnp.int32), n)
+    return np.asarray(segmented_scan_blocked(jnp.asarray(v), flags,
+                                             block_size=block_size))
+
+
+def _check(v, s, block_size):
+    ref = golden.host_segmented_scan(v, s)
+    out = _run_blocked(v, s, block_size)
+    scale = max(1.0, float(np.abs(ref).max()))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5 * scale)
+
+
+@pytest.mark.parametrize("block_size", [64, 256])
+def test_random_layout_matches_golden(block_size):
+    rng = np.random.default_rng(0)
+    n = 2048
+    s = np.concatenate(
+        [[0], np.sort(rng.choice(np.arange(1, n), 63, replace=False))]
+    ).astype(np.int32)
+    _check(rng.standard_normal(n).astype(np.float32), s, block_size)
+
+
+def test_head_on_block_boundary():
+    # heads exactly at block boundaries (and one mid-block): the carry
+    # must reset precisely at the boundary element, not one off
+    n, bs = 1024, 128
+    rng = np.random.default_rng(1)
+    s = np.array([0, 128, 256, 300, 512, 896], dtype=np.int32)
+    _check(rng.standard_normal(n).astype(np.float32), s, bs)
+
+
+def test_one_giant_segment_threads_carry_through_every_block():
+    n, bs = 4096, 64
+    v = np.ones(n, dtype=np.float32)
+    s = np.array([0], dtype=np.int32)
+    out = _run_blocked(v, s, bs)
+    np.testing.assert_allclose(out, np.arange(1, n + 1, dtype=np.float32))
+
+
+def test_all_singleton_segments_identity():
+    # every segment length 1 → the scan is the identity.  The blocked
+    # form computes it as cumsum[i] − cumsum[i−1], exact only when the
+    # partial sums are exact — bitwise on integer-valued data, rounding-
+    # tolerance on general floats (the documented tolerance model).
+    n, bs = 512, 64
+    rng = np.random.default_rng(2)
+    s = np.arange(n, dtype=np.int32)
+    vi = rng.integers(-100, 100, n).astype(np.float32)
+    np.testing.assert_array_equal(_run_blocked(vi, s, bs), vi)
+    vf = rng.standard_normal(n).astype(np.float32)
+    np.testing.assert_allclose(_run_blocked(vf, s, bs), vf,
+                               rtol=1e-5, atol=1e-4)
+
+
+@pytest.mark.parametrize("n", [100, 4097, 5000])
+def test_non_multiple_of_block_n(n):
+    # the internal pad must stay quarantined in its own segment
+    rng = np.random.default_rng(3)
+    p = max(3, n // 50)
+    s = np.concatenate(
+        [[0], np.sort(rng.choice(np.arange(1, n), p - 1, replace=False))]
+    ).astype(np.int32)
+    _check(rng.standard_normal(n).astype(np.float32), s, 256)
+
+
+def test_flat_vs_blocked_bitwise_on_integer_values():
+    # integer-valued f32: all sums exact → association is irrelevant and
+    # the two kernels must agree to the bit
+    rng = np.random.default_rng(4)
+    n = 3000
+    v = rng.integers(-8, 8, n).astype(np.float32)
+    s = np.concatenate(
+        [[0], np.sort(rng.choice(np.arange(1, n), 29, replace=False))]
+    ).astype(np.int32)
+    flags = head_flags_from_starts(jnp.asarray(s), n)
+    a = np.asarray(segmented_scan_flat(jnp.asarray(v), flags))
+    b = np.asarray(segmented_scan_blocked(jnp.asarray(v), flags,
+                                          block_size=128))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_auto_dispatch_small_n_is_bitwise_flat():
+    # below the threshold the dispatcher must BE the flat kernel (bitwise):
+    # existing small-shape callers rely on unchanged rounding
+    rng = np.random.default_rng(5)
+    n = 777
+    assert n < BLOCKED_SCAN_THRESHOLD
+    v = rng.standard_normal(n).astype(np.float32)
+    s = np.array([0, 100, 300], dtype=np.int32)
+    flags = head_flags_from_starts(jnp.asarray(s), n)
+    np.testing.assert_array_equal(
+        np.asarray(segmented_scan(jnp.asarray(v), flags)),
+        np.asarray(segmented_scan_flat(jnp.asarray(v), flags)))
+
+
+def test_auto_dispatch_large_n_matches_golden():
+    n = BLOCKED_SCAN_THRESHOLD  # smallest size routed to the blocked form
+    rng = np.random.default_rng(6)
+    v = rng.standard_normal(n).astype(np.float32)
+    s = np.concatenate(
+        [[0], np.sort(rng.choice(np.arange(1, n), 99, replace=False))]
+    ).astype(np.int32)
+    flags = head_flags_from_starts(jnp.asarray(s), n)
+    out = np.asarray(segmented_scan(jnp.asarray(v), flags))
+    ref = golden.host_segmented_scan(v, s)
+    scale = max(1.0, float(np.abs(ref).max()))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5 * scale)
+
+
+def test_blocked_f64():
+    rng = np.random.default_rng(7)
+    n = 2000
+    v = rng.standard_normal(n)  # f64 via x64 disabled → downcast? keep f32
+    v = v.astype(np.float32)
+    s = np.array([0, 1, 2, 1999], dtype=np.int32)  # singleton-heavy layout
+    _check(v, s, 256)
+
+
+# ------------------------------------------------- engine-level kernels
+
+def test_spmv_blocked_kernel_matches_flat():
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(20_000, 300, 299, iters=5, seed=21)
+    out_flat = sp.run_spmv_scan(prob, kernel="flat")
+    out_blocked = sp.run_spmv_scan(prob, kernel="blocked")
+    scale = max(1.0, float(np.abs(out_flat).max()))
+    np.testing.assert_allclose(out_blocked, out_flat, rtol=1e-4,
+                               atol=1e-5 * scale)
+
+
+def test_spmv_pallas_unfused_matches_fused():
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(3000, 40, 39, iters=4, seed=22)
+    fused = sp.run_spmv_scan(prob, kernel="pallas-fused")
+    unfused = sp.run_spmv_scan(prob, kernel="pallas")
+    scale = max(1.0, float(np.abs(fused).max()))
+    np.testing.assert_allclose(unfused, fused, rtol=1e-4, atol=1e-5 * scale)
+
+
+def test_spmv_bytes_moved_accounting():
+    from cme213_tpu.apps.spmv_scan import bytes_moved
+
+    # per iteration: read a + read xx (elem each) + read int32 flags +
+    # write a — the single-pass useful-byte convention
+    assert bytes_moved(1000, 1) == 1000 * 16
+    assert bytes_moved(1000, 7) == 7 * 1000 * 16
+    assert bytes_moved(1000, 2, elem=8) == 2 * 1000 * 28
+
+
+def test_spmv_scan_sweep_quick():
+    from cme213_tpu.bench.sweeps import spmv_scan_sweep
+
+    rows = spmv_scan_sweep(ns=(4096,), iters=2, kernels=("flat", "blocked"))
+    assert [r["kernel"] for r in rows] == ["flat", "blocked"]
+    assert all(r["gbs"] > 0 and not r["error"] for r in rows)
+    assert all(float(r["rel_l2"]) < 1e-4 for r in rows)
+
+
+def test_banked_rows_filtered_by_dtype(tmp_path, monkeypatch):
+    """f32 device rows must not surface as banked evidence in the f64
+    bench output (ADVICE r5); pre-dtype rows read as f32."""
+    import json
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import bench
+
+    results = tmp_path / "bench_results"
+    results.mkdir()
+    rows = {
+        "tranche1_xla.json":           # legacy row, no dtype field → f32
+            {"kernel": "xla", "ok": True, "platform": "tpu", "gbs": 50.85},
+        "tranche1_pipeline-k4.json":   # tagged f32
+            {"kernel": "pipeline-k4", "ok": True, "platform": "tpu",
+             "dtype": "f32", "gbs": 251.8},
+        "tranche1_xla_f64.json":       # tagged f64
+            {"kernel": "xla", "ok": True, "platform": "tpu",
+             "dtype": "f64", "gbs": 25.0},
+    }
+    for name, row in rows.items():
+        (results / name).write_text(json.dumps(row))
+    monkeypatch.setattr(bench.os.path, "dirname", lambda p: str(tmp_path))
+
+    f32 = bench._banked_rows("f32")
+    assert {r["kernel"] for r in f32} == {"xla", "pipeline-k4"}
+    assert all(r.get("dtype", "f32") == "f32" for r in f32)
+    f64 = bench._banked_rows("f64")
+    assert [r["gbs"] for r in f64] == [25.0]
