@@ -25,7 +25,7 @@ from ..dist import mesh_for_method, run_distributed_heat
 from ..grid import make_initial_grid, save_grid_to_file
 from ..ops import run_heat
 from ..ops.stencil import flops_per_point
-from ..ops.stencil_pipeline import pick_pipeline_tile, run_heat_pipeline
+from ..ops.stencil_pipeline import pick_pipeline_tile, run_heat_resilient
 from ..verify import check_ulp, golden
 
 
@@ -72,21 +72,22 @@ def run_single(params: SimParams, check_cpu: bool = True,
         _report(params, "xla", timer.last_ms("gpu computation global")))
 
     # tuned Pallas path (the "shared memory" kernel analog): the pipelined
-    # kernel (ops/stencil_pipeline.py)
+    # kernel (ops/stencil_pipeline.py), behind the fallback ladder — a
+    # rung that fails to lower or run (real or CME213_FAULTS-injected)
+    # demotes pipeline → pipeline2d → xla instead of aborting the solve
     tile = pick_pipeline_tile(params.gy, 1, params.order, width=params.gx)
     interpret = jax.devices()[0].platform != "tpu"
-
-    def pallas_run():
-        return run_heat_pipeline(jnp.array(u0), params.iters, params.order,
-                                 params.xcfl, params.ycfl, params.bc,
-                                 k=1, tile_y=tile, interpret=interpret)
-
-    pallas_run().block_until_ready()
-    with timer.phase("gpu computation shared") as ph:
-        out_pl = pallas_run()
-        ph.block(out_pl)
+    res = run_heat_resilient(jnp.array(u0), params.iters, params.order,
+                             params.xcfl, params.ycfl, params.bc,
+                             k=1, tile_y=tile, interpret=interpret,
+                             timer=timer)
+    out_pl = res.value
+    label = "pallas" if not res.demoted else f"pallas->{res.rung}"
+    if res.demoted:
+        print(f"heat2d: tuned kernel demoted to {res.rung!r} "
+              f"(failed: {', '.join(f.rung for f in res.failures)})")
     result.reports.append(
-        _report(params, "pallas", timer.last_ms("gpu computation shared")))
+        _report(params, label, timer.last_ms("gpu computation shared")))
 
     if save_files and ref is not None:
         # the reference's artifact set includes the golden dump
@@ -106,6 +107,36 @@ def run_single(params: SimParams, check_cpu: bool = True,
     for r in result.reports:
         print(r)
     return result
+
+
+def run_heat_checkpointed(params: SimParams, path: str, every: int = 0,
+                          max_retries: int = 1) -> np.ndarray:
+    """Long-solve form of the single-device heat driver: checkpointed
+    chunks with a finiteness guard between them (host-side, outside the
+    jitted loop — the hot ``fori_loop`` is untouched).
+
+    The checkpoint state is a pytree ``{"grid": u}`` — the halo bands ride
+    inside the grid, and ``core/checkpoint.py`` restores arbitrary pytrees,
+    so richer states (e.g. split ``(grid, halo)``) checkpoint the same way
+    without hand-flattening.  A NaN blow-up (injected via
+    ``CME213_FAULTS=nan:heat2d`` or real, e.g. an unstable CFL) rolls back
+    to the last good checksummed checkpoint and retries the chunk; a
+    killed process resumes from ``path``.  Deterministic chunking makes an
+    interrupted-and-resumed solve bitwise equal to an uninterrupted one.
+    """
+    from ..core.checkpoint import run_with_checkpoints
+    from ..core.resilience import all_finite
+
+    u0 = make_initial_grid(params, dtype=jnp.float32)
+
+    def step(state, k):
+        return {"grid": run_heat(jnp.asarray(state["grid"]), k,
+                                 params.order, params.xcfl, params.ycfl)}
+
+    out = run_with_checkpoints(step, {"grid": u0}, params.iters, path,
+                               every=every, guard=all_finite, op="heat2d",
+                               max_retries=max_retries)
+    return np.asarray(out["grid"])
 
 
 def run_distributed(params: SimParams, num_devices: int | None = None,

@@ -217,8 +217,55 @@ def bytes_moved(n: int, iters: int, elem: int = 4) -> int:
     return per_iter * iters
 
 
+#: demotion ladder per requested kernel — Pallas rungs degrade to the
+#: blocked O(n) XLA scan, then to the flat log-sweep (which has no special
+#: lowering requirements at all); the XLA rungs degrade straight to flat
+FALLBACK_LADDERS = {
+    "pallas-fused": ("pallas-fused", "blocked", "flat"),
+    "pallas": ("pallas", "blocked", "flat"),
+    "auto": ("auto", "flat"),
+    "blocked": ("blocked", "flat"),
+    "dense": ("dense", "flat"),
+    "flat": ("flat",),
+}
+
+
+def _make_runner(prob: Problem, xx, flags, kernel: str):
+    """runner(v) executing all N iterations with the named kernel."""
+    import jax
+
+    if kernel == "pallas-fused":
+        from ..ops.segmented_pallas import spmv_scan_pallas
+
+        interpret = jax.devices()[0].platform != "tpu"
+        return lambda v: spmv_scan_pallas(v, xx, flags, prob.iters,
+                                          interpret=interpret)
+    if kernel == "pallas":
+        interpret = jax.devices()[0].platform != "tpu"
+        return lambda v: _iterate_pallas_unfused(v, xx, flags, prob.iters,
+                                                 interpret=interpret)
+    if kernel in _SCAN_KERNELS:
+        return lambda v: _iterate(v, xx, flags, prob.iters, scan=kernel)
+    if kernel == "dense":
+        from ..ops.segmented import segmented_scan_dense
+
+        starts = jnp.asarray(prob.s[:-1])
+        max_len = int(np.diff(prob.s).max())
+
+        @partial(jax.jit, static_argnames=("iters",), donate_argnums=(0,))
+        def _iterate_dense(v, xx, iters: int):
+            def body(_, v):
+                return segmented_scan_dense(v * xx, starts, max_len)
+
+            return jax.lax.fori_loop(0, iters, body, v)
+
+        return lambda v: _iterate_dense(v, xx, prob.iters)
+    raise ValueError(f"unknown kernel {kernel!r}")
+
+
 def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
-                  dtype=jnp.float32, kernel: str = "auto") -> np.ndarray:
+                  dtype=jnp.float32, kernel: str = "auto",
+                  fallback: bool = True) -> np.ndarray:
     """Device pipeline (fp.cu:154-190): upload, N × (multiply + segmented
     scan), download — the N iterations run as ONE jitted ``fori_loop``
     with the value buffer donated, whatever the kernel.  Prints the
@@ -235,51 +282,83 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
       XLA (isolates the fusion win);
     - "dense": the per-segment dense-matrix strawman (the role the
       reference kept ``fp_old.cu`` around for — O(p·max_seg_len) work).
+
+    With ``fallback`` (default), a rung that fails to compile or run —
+    injected or real — demotes down ``FALLBACK_LADDERS[kernel]`` instead
+    of aborting: the op completes on a working kernel and the demotion is
+    recorded as structured ``rung-failed``/``served`` trace events
+    (``core/resilience.with_fallback``).  ``fallback=False`` keeps the
+    reference's fail-fast behavior.  The fault-injection guard and the
+    ladder bookkeeping run in host Python before the jitted loop launches,
+    so the healthy path times identically.
     """
-    import jax
+    from ..core import check_op, with_fallback
 
     prob.validate()
-    a = jnp.asarray(prob.a, dtype)
     xx = jnp.asarray(prob.xx, dtype)
     flags = head_flags_from_starts(jnp.asarray(prob.s[:-1]), prob.n)
     timer = timer or PhaseTimer()
-    if kernel == "pallas-fused":
-        from ..ops.segmented_pallas import spmv_scan_pallas
 
-        interpret = jax.devices()[0].platform != "tpu"
-        runner = lambda v: spmv_scan_pallas(v, xx, flags, prob.iters,
-                                            interpret=interpret)
-    elif kernel == "pallas":
-        interpret = jax.devices()[0].platform != "tpu"
-        runner = lambda v: _iterate_pallas_unfused(v, xx, flags, prob.iters,
-                                                   interpret=interpret)
-    elif kernel in _SCAN_KERNELS:
-        runner = lambda v: _iterate(v, xx, flags, prob.iters, scan=kernel)
-    elif kernel == "dense":
-        from ..ops.segmented import segmented_scan_dense
+    def attempt(rung: str):
+        def thunk():
+            runner = _make_runner(prob, xx, flags, rung)
+            # every kernel donates its value buffer, so each attempt gets
+            # a fresh host->device upload — a rung that dies mid-run must
+            # not leave the next rung a donated (invalid) buffer
+            a = jnp.asarray(prob.a, dtype)
+            # warmup compile outside the timed region (the CUDA analog
+            # timed only kernel execution between cudaEvents); the named
+            # barrier forces compile/runtime failures to surface HERE,
+            # attributed to the rung, before the timed phase opens
+            check_op(f"spmv_scan.{rung}", runner(jnp.zeros_like(a)))
+            with timer.phase("spmv_scan") as ph:
+                out = runner(a)
+                ph.block(out)
+            return out
+        return thunk
 
-        starts = jnp.asarray(prob.s[:-1])
-        max_len = int(np.diff(prob.s).max())
-
-        @partial(jax.jit, static_argnames=("iters",), donate_argnums=(0,))
-        def _iterate_dense(v, xx, iters: int):
-            def body(_, v):
-                return segmented_scan_dense(v * xx, starts, max_len)
-
-            return jax.lax.fori_loop(0, iters, body, v)
-
-        runner = lambda v: _iterate_dense(v, xx, prob.iters)
-    else:
-        raise ValueError(f"unknown kernel {kernel!r}")
-    # warmup compile outside the timed region (the CUDA analog timed only
-    # kernel execution between cudaEvents)
-    runner(jnp.zeros_like(a)).block_until_ready()
-    with timer.phase("spmv_scan") as ph:
-        out = runner(a)
-        ph.block(out)
+    rungs = FALLBACK_LADDERS[kernel] if fallback else (kernel,)
+    res = with_fallback("spmv_scan", [(r, attempt(r)) for r in rungs])
+    if res.demoted:
+        print(f"spmv_scan: kernel {kernel!r} demoted to {res.rung!r} "
+              f"(failed: {', '.join(f.rung for f in res.failures)})")
     ms = timer.last_ms("spmv_scan")
     print(f"The running time of my code for {prob.iters} iterations is: "
           f"{ms} milliseconds.")
+    return np.asarray(res.value)
+
+
+def run_spmv_scan_checkpointed(prob: Problem, path: str, every: int = 0,
+                               kernel: str = "auto", dtype=jnp.float32,
+                               max_retries: int = 1) -> np.ndarray:
+    """Long-solve form of the engine: the N iterations run in checkpointed
+    chunks of ``every`` with a finiteness guard on each chunk (host-side,
+    outside the jitted ``fori_loop`` — zero overhead inside the hot loop).
+
+    A NaN blow-up (injected via ``CME213_FAULTS=nan:spmv_scan`` or real)
+    rolls back to the last good checksummed checkpoint and retries the
+    chunk; a killed process resumes from ``path`` on relaunch.  Chunking is
+    deterministic, so an interrupted-and-resumed solve is bitwise equal to
+    an uninterrupted one with the same ``every``.  ``kernel`` must be one
+    of the XLA scans (auto/flat/blocked).
+    """
+    from ..core.checkpoint import run_with_checkpoints
+    from ..core.resilience import all_finite
+
+    if kernel not in _SCAN_KERNELS:
+        raise ValueError(f"checkpointed runs use the XLA kernels "
+                         f"{tuple(_SCAN_KERNELS)}, not {kernel!r}")
+    prob.validate()
+    xx = jnp.asarray(prob.xx, dtype)
+    flags = head_flags_from_starts(jnp.asarray(prob.s[:-1]), prob.n)
+
+    def step(state, k):
+        return _iterate(jnp.asarray(state, dtype), xx, flags, k,
+                        scan=kernel)
+
+    out = run_with_checkpoints(step, jnp.asarray(prob.a, dtype), prob.iters,
+                               path, every=every, guard=all_finite,
+                               op="spmv_scan", max_retries=max_retries)
     return np.asarray(out)
 
 

@@ -614,7 +614,10 @@ def spmv_scan_sweep(ns=(1 << 16, 1 << 20, 1 << 22), iters: int = 8,
         for kernel in kernels:
             timer = PhaseTimer()
             try:
-                out = sp.run_spmv_scan(prob, timer=timer, kernel=kernel)
+                # fallback off: a kernel failing at this shape must surface as a
+                # data row (or coverage failure), not silently demote
+                out = sp.run_spmv_scan(prob, timer=timer, kernel=kernel,
+                                       fallback=False)
             except Exception as e:  # a kernel failing at a shape is data
                 _raise_if_device_error(e)
                 rows.append({"n": n, "p": p, "iters": iters,
@@ -662,8 +665,10 @@ def spmv_pallas_coverage(names=None, scale: float = 1.0,
         prob = dataclasses.replace(prob, iters=iters)
         rel = None
         try:
-            out_pallas = sp.run_spmv_scan(prob, kernel="pallas-fused")
-            out_flat = sp.run_spmv_scan(prob, kernel="flat")
+            out_pallas = sp.run_spmv_scan(prob, kernel="pallas-fused",
+                                          fallback=False)
+            out_flat = sp.run_spmv_scan(prob, kernel="flat",
+                                        fallback=False)
             rel = float(np.linalg.norm(out_pallas - out_flat)
                         / max(np.linalg.norm(out_flat), 1e-30))
             ok, err = bool(rel < 1e-4), ""
@@ -730,7 +735,10 @@ def spmv_suite_sweep(names=None, scale: float = 0.05,
                 native.set_threads(prev)
         for kernel in kernels:
             timer = PhaseTimer()
-            out = sp.run_spmv_scan(prob, timer=timer, kernel=kernel)
+            # fallback off: a failing kernel must fail this timing row,
+            # not silently demote to (and time) a different kernel
+            out = sp.run_spmv_scan(prob, timer=timer, kernel=kernel,
+                                   fallback=False)
             errs = sp.external_check(prob, out)
             row = {
                 "matrix": name, "source": source, "kernel": kernel,

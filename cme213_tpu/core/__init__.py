@@ -1,6 +1,10 @@
 from .timing import PhaseTimer, bandwidth_gbs, gflops
 from .compare import ulp_distance, almost_equal_ulps
 from .errors import check_op, FrameworkError
+from .resilience import (FailureKind, FallbackResult, NonFiniteError,
+                         RetryPolicy, all_finite, classify_failure,
+                         with_fallback)
+from .trace import clear_events, events, record_event
 
 __all__ = [
     "PhaseTimer",
@@ -10,4 +14,14 @@ __all__ = [
     "almost_equal_ulps",
     "check_op",
     "FrameworkError",
+    "FailureKind",
+    "FallbackResult",
+    "NonFiniteError",
+    "RetryPolicy",
+    "all_finite",
+    "classify_failure",
+    "with_fallback",
+    "record_event",
+    "events",
+    "clear_events",
 ]

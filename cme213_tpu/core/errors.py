@@ -5,29 +5,63 @@ TPU-native analog of the reference's ``check_launch(name)`` (sync +
 ``MPI_SAFE_CALL`` (``hw/hw5/programming/2dHeat.cpp:45-51``).  JAX device
 errors surface lazily on materialization; ``check_op`` forces them at a named
 point so failures carry the op label, like the reference's kernel names.
+
+Unlike the reference's abort-on-first-error, a failed barrier here emits a
+structured record — op name, exception class, elapsed ms — through the
+``core/trace.py`` event log (and any ``PhaseTimer`` passed in) before
+raising, so the resilience layer's demotions and retries are observable in
+capture logs instead of vanishing into a formatted string.
 """
 
 from __future__ import annotations
 
+import time
+
 import jax
+
+from .trace import record_event
 
 
 class FrameworkError(RuntimeError):
-    pass
+    """Named-op failure; ``.record`` holds the structured trace record."""
+
+    record: dict | None = None
 
 
-def check_op(name: str, *arrays):
+def check_op(name: str, *arrays, timer=None):
     """Block until ``arrays`` are ready; re-raise any device error with ``name``.
 
     Returns the arrays (single array unwrapped) so it can be used inline::
 
         out = check_op("gpu shift cypher", shift(x))
+
+    With ``timer`` (a ``PhaseTimer``), the blocking time is appended to the
+    timer's records under ``name`` — success or failure — so barrier costs
+    show up next to the phases they guard.  On failure the structured
+    record ``{event: "op-failure", op, error, ms}`` is emitted through
+    ``core/trace.record_event`` and attached to the raised
+    ``FrameworkError`` as ``.record``.
     """
+    start = time.perf_counter()
     try:
         for a in arrays:
             jax.block_until_ready(a)
     except Exception as e:  # XlaRuntimeError et al.
-        raise FrameworkError(f"error in {name}: {e}") from e
+        ms = (time.perf_counter() - start) * 1e3
+        rec = record_event("op-failure", op=name, error=type(e).__name__,
+                           ms=round(ms, 3), message=str(e)[:300])
+        if timer is not None:
+            from .timing import PhaseRecord
+
+            timer.records.append(PhaseRecord(name, ms))
+        err = FrameworkError(f"error in {name}: {e}")
+        err.record = rec
+        raise err from e
+    if timer is not None:
+        from .timing import PhaseRecord
+
+        timer.records.append(
+            PhaseRecord(name, (time.perf_counter() - start) * 1e3))
     if len(arrays) == 1:
         return arrays[0]
     return arrays

@@ -1,0 +1,251 @@
+"""Deterministic, env-driven fault injection.
+
+The reference's robustness story is fail-fast one-liners — ``check_launch``
+aborts on the first CUDA error (``hw/hw1/programming/mp1-util.h:8-18``) and
+``MPI_SAFE_CALL`` kills the job (``hw/hw5/programming/2dHeat.cpp:45-51``) —
+so nothing in it could ever be *tested* for graceful degradation.  This
+module is the other half of that story: a deterministic fault plan, read
+once from ``CME213_FAULTS``, that the resilience layer
+(``core/resilience.py``, ``core/checkpoint.py``, ``dist/launch.py``,
+``bench/run_all.py``) consults at its named guard points.  Faults fire on
+exact call counts — never timers or randomness — so every injected failure
+is reproducible in CI.
+
+Spec grammar (comma-separated clauses)::
+
+    CME213_FAULTS="clause[,clause...]"
+
+    fail:<op>[:<nth>[:<count>]]   the <nth> call (1-based, default 1) of
+                                  ``maybe_fail(op)`` raises InjectedFault,
+                                  as do the following <count>-1 calls
+                                  (default count 1) — the stand-in for an
+                                  XlaRuntimeError out of a named kernel
+    nan:<op>[:<nth>]              the <nth> call of ``maybe_poison(op, s)``
+                                  returns ``s`` with its first float leaf
+                                  NaN-poisoned (a mid-solve blow-up)
+    ckpt:truncate[:<nth>]         the <nth> checkpoint file written through
+                                  ``maybe_truncate_file`` is cut in half
+                                  (a torn write / preempted host)
+    rankkill:<rank>[:<step>]      ``maybe_kill_rank()`` hard-exits with
+                                  ``KILL_EXIT`` on guarded step <step>
+                                  (0-based, default 0) when
+                                  ``JAX_PROCESS_ID == rank`` and this is the
+                                  process's first incarnation
+                                  (``CME213_INCARNATION`` unset or 0) — so a
+                                  launcher restart survives deterministically
+
+Op names are dotted paths (``spmv_scan.pallas-fused``, ``heat.pipeline``,
+``sweep.heat_bandwidth``); colons are reserved for the grammar.
+
+Zero overhead when disabled: every ``maybe_*`` entry point returns after one
+cached ``None`` check, no env re-reads, no jax import at module scope — the
+guards live *outside* jitted code by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+#: exit code of an injected rank kill (distinct from shell/timeout codes)
+KILL_EXIT = 113
+
+
+class InjectedFault(RuntimeError):
+    """Deterministic injected failure (stands in for XlaRuntimeError)."""
+
+    injected = True
+
+
+class FaultSpecError(ValueError):
+    """Malformed CME213_FAULTS clause."""
+
+
+@dataclass
+class _Clause:
+    kind: str           # fail | nan | ckpt | rankkill
+    op: str             # op name ("truncate" for ckpt; rank id for rankkill)
+    nth: int = 1        # 1-based trigger call (rankkill: 0-based step)
+    count: int = 1      # consecutive triggered calls (fail only)
+    calls: int = 0      # mutable per-clause call counter
+
+    def fires(self) -> bool:
+        """Advance the counter; True when this call is in the window."""
+        self.calls += 1
+        return self.nth <= self.calls < self.nth + self.count
+
+
+@dataclass
+class FaultPlan:
+    clauses: list[_Clause] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        clauses = []
+        for raw in spec.split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            parts = raw.split(":")
+            kind = parts[0]
+            if kind not in ("fail", "nan", "ckpt", "rankkill") or len(parts) < 2:
+                raise FaultSpecError(
+                    f"bad fault clause {raw!r} (kinds: fail:<op>[:nth[:count]]"
+                    f", nan:<op>[:nth], ckpt:truncate[:nth], "
+                    f"rankkill:<rank>[:step])")
+            try:
+                if kind == "fail":
+                    clauses.append(_Clause(
+                        kind, parts[1],
+                        nth=int(parts[2]) if len(parts) > 2 else 1,
+                        count=int(parts[3]) if len(parts) > 3 else 1))
+                elif kind == "nan":
+                    clauses.append(_Clause(
+                        kind, parts[1],
+                        nth=int(parts[2]) if len(parts) > 2 else 1))
+                elif kind == "ckpt":
+                    if parts[1] != "truncate":
+                        raise FaultSpecError(
+                            f"unknown ckpt fault {parts[1]!r}")
+                    clauses.append(_Clause(
+                        kind, "truncate",
+                        nth=int(parts[2]) if len(parts) > 2 else 1))
+                else:  # rankkill
+                    clauses.append(_Clause(
+                        kind, parts[1],
+                        nth=int(parts[2]) if len(parts) > 2 else 0))
+            except ValueError as e:
+                if isinstance(e, FaultSpecError):
+                    raise
+                raise FaultSpecError(f"bad fault clause {raw!r}: {e}") from e
+        return cls(clauses)
+
+    def _matching(self, kind: str, op: str):
+        return [c for c in self.clauses if c.kind == kind and c.op == op]
+
+
+# cache: None = env not read yet; False = read and disabled
+_PLAN: FaultPlan | None | bool = None
+
+
+def active() -> FaultPlan | None:
+    """The installed plan, lazily read from ``CME213_FAULTS`` once."""
+    global _PLAN
+    if _PLAN is None:
+        spec = os.environ.get("CME213_FAULTS", "")
+        _PLAN = FaultPlan.parse(spec) if spec.strip() else False
+    return _PLAN or None
+
+
+def install(spec: str) -> FaultPlan:
+    """Install a plan programmatically (tests); overrides the env."""
+    global _PLAN
+    _PLAN = FaultPlan.parse(spec)
+    return _PLAN
+
+
+def reset() -> None:
+    """Forget the cached plan; the next guard re-reads the env."""
+    global _PLAN
+    _PLAN = None
+
+
+@contextmanager
+def injected(spec: str):
+    """Scoped plan installation for tests: counters are fresh inside."""
+    prev = _PLAN
+    try:
+        yield install(spec)
+    finally:
+        globals()["_PLAN"] = prev
+
+
+def _record(kind: str, op: str, **fields) -> None:
+    from .trace import record_event
+
+    record_event("fault-injected", kind=kind, op=op, **fields)
+
+
+def maybe_fail(op: str) -> None:
+    """Raise InjectedFault if a ``fail:<op>`` clause fires on this call."""
+    plan = active()
+    if plan is None:
+        return
+    for c in plan._matching("fail", op):
+        if c.fires():
+            _record("fail", op, call=c.calls)
+            raise InjectedFault(
+                f"injected failure in {op} (call {c.calls})")
+
+
+def maybe_poison(op: str, state):
+    """NaN-poison the first float leaf of ``state`` if a ``nan:<op>``
+    clause fires on this call; otherwise return ``state`` unchanged."""
+    plan = active()
+    if plan is None:
+        return state
+    fire = any(c.fires() for c in plan._matching("nan", op))
+    if not fire:
+        return state
+    import numpy as np
+
+    try:
+        from jax import tree_util
+        leaves, treedef = tree_util.tree_flatten(state)
+    except ImportError:  # pragma: no cover - jax always present here
+        leaves, treedef = [state], None
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating):
+            arr = np.array(arr)  # host copy; never mutate a device buffer
+            arr.reshape(-1)[0] = np.nan
+            leaves[i] = arr
+            _record("nan", op, leaf=i)
+            break
+    return treedef.unflatten(leaves) if treedef is not None else leaves[0]
+
+
+def maybe_truncate_file(path: str) -> bool:
+    """Cut ``path`` in half if a ``ckpt:truncate`` clause fires (the torn
+    checkpoint write).  Returns True when the file was damaged."""
+    plan = active()
+    if plan is None:
+        return False
+    if not any(c.fires() for c in plan._matching("ckpt", "truncate")):
+        return False
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+    _record("ckpt-truncate", path, bytes=size // 2)
+    return True
+
+
+def incarnation() -> int:
+    """This process's launcher restart count (0 = first launch)."""
+    return int(os.environ.get("CME213_INCARNATION", "0") or "0")
+
+
+def maybe_kill_rank(step: int | None = None) -> None:
+    """Hard-exit (``os._exit(KILL_EXIT)``) if a ``rankkill`` clause matches
+    this rank at this guarded step, first incarnation only.
+
+    ``step=None`` uses the clause's own call counter as the step index, so
+    a solver can simply call this once per chunk.
+    """
+    plan = active()
+    if plan is None:
+        return
+    rank = os.environ.get("JAX_PROCESS_ID", "0")
+    for c in plan.clauses:
+        if c.kind != "rankkill" or c.op != rank:
+            continue
+        at = step if step is not None else c.calls
+        c.calls += 1
+        if at == c.nth and incarnation() == 0:
+            _record("rankkill", rank, step=at)
+            sys.stderr.write(
+                f"[faults] injected kill: rank {rank} at step {at}\n")
+            sys.stderr.flush()
+            os._exit(KILL_EXIT)

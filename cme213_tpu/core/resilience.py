@@ -1,0 +1,183 @@
+"""Failure classification, bounded retry, and the kernel fallback ladder.
+
+The reference's failure model is binary — ``check_launch`` aborts, or the
+job is fine (``hw/hw1/programming/mp1-util.h:8-18``).  A production jax_graft
+system needs the middle ground: a Pallas kernel that fails to lower on one
+platform should *demote* to the XLA formulation of the same op, a transient
+runtime error should retry with bounded deterministic backoff, and a NaN
+blow-up should be recognized as numeric (retrying the same program is
+pointless; rolling back to a checkpoint is not).  Three pieces:
+
+- ``classify_failure`` buckets an exception as COMPILE (lowering/Mosaic/
+  unsupported-op — deterministic, never retried on the same rung), NUMERIC
+  (non-finite values — handled by checkpoint rollback, see
+  ``core/checkpoint.run_with_checkpoints``), or RUNTIME (everything else,
+  including XlaRuntimeError and injected faults — retryable).
+- ``RetryPolicy`` — bounded attempts with a deterministic geometric backoff
+  (no jitter: CI reproducibility beats thundering-herd avoidance at this
+  scale).
+- ``with_fallback`` — run a ladder of (rung, thunk) candidates in order,
+  consult the fault plan per rung (``faults.maybe_fail``), record every
+  demotion through the structured trace log, and report which rung actually
+  served the request.
+
+Every guard here runs in host Python outside jit — zero device overhead,
+and zero work at all when no faults are installed and the first rung holds.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+
+from .errors import FrameworkError
+from .faults import maybe_fail
+from .trace import record_event
+
+
+class FailureKind(str, Enum):
+    COMPILE = "compile"
+    RUNTIME = "runtime"
+    NUMERIC = "numeric"
+
+
+class NonFiniteError(ArithmeticError):
+    """A finiteness guard tripped: the state contains NaN/Inf."""
+
+
+# substrings (lowercased) marking a deterministic compile/lowering failure —
+# retrying the identical program cannot succeed, but a different kernel
+# formulation of the same op can
+_COMPILE_MARKERS = ("mosaic", "lowering", "lower", "compil", "unsupported",
+                    "unimplemented", "vmem", "mlir")
+_NUMERIC_MARKERS = ("nan", "non-finite", "not finite", "overflow")
+
+
+def classify_failure(exc: BaseException) -> FailureKind:
+    """COMPILE / NUMERIC / RUNTIME bucket for a caught exception."""
+    if isinstance(exc, (NonFiniteError, FloatingPointError, ZeroDivisionError)):
+        return FailureKind.NUMERIC
+    if isinstance(exc, FrameworkError) and exc.__cause__ is not None:
+        return classify_failure(exc.__cause__)
+    if isinstance(exc, NotImplementedError):
+        return FailureKind.COMPILE
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    if any(m in msg for m in _NUMERIC_MARKERS):
+        return FailureKind.NUMERIC
+    if any(m in msg for m in _COMPILE_MARKERS):
+        return FailureKind.COMPILE
+    return FailureKind.RUNTIME
+
+
+def all_finite(state) -> bool:
+    """Finiteness guard over a pytree of arrays (host-side, outside jit)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(state):
+        arr = jnp.asarray(leaf)
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        if not bool(np.asarray(jnp.all(jnp.isfinite(arr)))):
+            return False
+    return True
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded retry with deterministic geometric backoff.
+
+    ``run(fn)`` retries only RUNTIME-classified failures (by default):
+    compile failures are deterministic and numeric failures belong to the
+    checkpoint-rollback path, so retrying either wastes device minutes.
+    """
+
+    max_retries: int = 2
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    max_delay_s: float = 2.0
+    retry_on: tuple = (FailureKind.RUNTIME,)
+    sleep: object = field(default=time.sleep, repr=False)
+
+    def delays(self) -> list[float]:
+        return [min(self.base_delay_s * self.multiplier ** i,
+                    self.max_delay_s) for i in range(self.max_retries)]
+
+    def run(self, fn, op: str = "retry"):
+        last = None
+        for attempt, delay in enumerate([0.0] + self.delays()):
+            if delay:
+                self.sleep(delay)
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — classify, then decide
+                kind = classify_failure(e)
+                last = e
+                if kind not in self.retry_on or attempt >= self.max_retries:
+                    raise
+                record_event("retry", op=op, attempt=attempt + 1,
+                             kind=kind.value, error=type(e).__name__,
+                             next_delay_s=self.delays()[attempt])
+        raise last  # pragma: no cover — loop always returns or raises
+
+
+@dataclass
+class RungFailure:
+    rung: str
+    kind: FailureKind
+    error: str
+    message: str
+
+
+@dataclass
+class FallbackResult:
+    """What ``with_fallback`` actually ran: the value, the serving rung,
+    and every rung that failed on the way down the ladder."""
+
+    value: object
+    rung: str
+    failures: list[RungFailure] = field(default_factory=list)
+
+    @property
+    def demoted(self) -> bool:
+        return bool(self.failures)
+
+
+def with_fallback(op: str, ladder, policy: RetryPolicy | None = None
+                  ) -> FallbackResult:
+    """Run the first rung of ``ladder`` (a sequence of ``(name, thunk)``)
+    that succeeds, demoting down the ladder on failure.
+
+    Per rung: the fault plan is consulted first (``maybe_fail(f"{op}.{name}")``
+    — an injected failure demotes exactly like a real one), then the thunk
+    runs (under ``policy`` when given, which retries transient RUNTIME
+    failures *within* the rung before demoting).  Each failed rung emits a
+    structured ``rung-failed`` event; the serving rung emits ``served`` with
+    ``demoted`` and the failure list, so capture logs show which kernel
+    actually handled the request.  All-rungs-failed raises FrameworkError
+    chained to the last failure.
+    """
+    failures: list[RungFailure] = []
+    last: Exception | None = None
+    for name, thunk in ladder:
+        try:
+            maybe_fail(f"{op}.{name}")
+            value = (thunk() if policy is None
+                     else policy.run(thunk, op=f"{op}.{name}"))
+        except Exception as e:  # noqa: BLE001 — every rung failure is data
+            kind = classify_failure(e)
+            failures.append(RungFailure(name, kind, type(e).__name__,
+                                        str(e)[:300]))
+            record_event("rung-failed", op=op, rung=name, kind=kind.value,
+                         error=type(e).__name__)
+            last = e
+            continue
+        record_event("served", op=op, rung=name, demoted=bool(failures),
+                     failed_rungs=[f.rung for f in failures])
+        return FallbackResult(value, name, failures)
+    raise FrameworkError(
+        f"all {len(failures)} rungs of {op} failed: "
+        + "; ".join(f"{f.rung}[{f.kind.value}] {f.error}" for f in failures)
+    ) from last

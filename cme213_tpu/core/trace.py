@@ -6,11 +6,56 @@ adds the device-level profile the CUDA events couldn't give: a context
 manager around ``jax.profiler`` producing an XPlane trace (viewable in
 TensorBoard/Perfetto) for kernel-level overlap verification — which SURVEY §7
 calls out as the way "async" overlap must be verified on TPU.
+
+It also carries the structured event log of the resilience layer: op
+failures (``core/errors.check_op``), fallback-ladder demotions and retries
+(``core/resilience.py``), checkpoint quarantines (``core/checkpoint.py``)
+and injected faults (``core/faults.py``) all flow through ``record_event``
+as dicts, so capture logs can be grepped for machine-readable records
+instead of formatted strings.  Set ``CME213_TRACE_FILE`` to also append
+each event as a JSON line (the capture-log path).
 """
 
 from __future__ import annotations
 
+import json
+import os
+import threading
+import time
 from contextlib import contextmanager
+
+_EVENTS: list[dict] = []
+_LOCK = threading.Lock()
+
+
+def record_event(event: str, **fields) -> dict:
+    """Append a structured event to the in-process log (and the
+    ``CME213_TRACE_FILE`` JSON-lines sink, when set).  Returns the record."""
+    rec = {"event": event, "t": round(time.time(), 6), **fields}
+    with _LOCK:
+        _EVENTS.append(rec)
+    path = os.environ.get("CME213_TRACE_FILE")
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(rec, default=str) + "\n")
+        except OSError:
+            pass  # a broken sink must never take down the workload
+    return rec
+
+
+def events(event: str | None = None) -> list[dict]:
+    """Snapshot of recorded events, optionally filtered by event name."""
+    with _LOCK:
+        snap = list(_EVENTS)
+    if event is None:
+        return snap
+    return [e for e in snap if e["event"] == event]
+
+
+def clear_events() -> None:
+    with _LOCK:
+        _EVENTS.clear()
 
 
 @contextmanager

@@ -11,8 +11,29 @@ It picks a free coordinator port, spawns N copies of the command with the
 standard launcher env (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``,
 ``JAX_PROCESS_ID``) that ``dist.multihost.initialize_multihost`` consumes,
 prefixes each line of output with its rank (mpirun's ``-tag-output``), and
-exits nonzero if any rank fails (fail-fast, the MPI_Abort analog: remaining
-ranks are terminated when the first one dies).
+exits nonzero if any rank fails.
+
+Unlike the reference's MPI_Abort-only model, failure handling is layered:
+
+- ``--max-restarts N``: a rank that exits nonzero is relaunched with the
+  SAME rank id (and ``CME213_INCARNATION`` bumped, so deterministic fault
+  injection — ``CME213_FAULTS=rankkill:...`` — fires only on the first
+  incarnation) up to N times before the job is declared dead.  Restarts
+  cover restart-tolerant workloads (idempotent scripts, solvers resuming
+  from ``core/checkpoint.py``); ranks blocked inside a collective when a
+  peer dies still need the whole-job retry their checkpoint enables.
+- ``--timeout SECS``: a hard wall-clock deadline on the whole job — the
+  fix for a stuck coordinator handshake hanging the launcher forever.
+  Expiry kills all ranks and returns 124 (the ``timeout(1)`` convention,
+  which the capture layer already classifies as a device hang).
+- ``--handshake-timeout SECS``: exported to ranks as
+  ``CME213_HANDSHAKE_TIMEOUT``; ``dist.multihost.initialize_multihost``
+  feeds it to ``jax.distributed.initialize(initialization_timeout=...)``
+  so a rank whose coordinator never appears fails fast (and can then be
+  restarted) instead of blocking for JAX's 5-minute default.
+
+Only a rank exhausting its restart budget fails the job (fail-fast: the
+remaining ranks are then terminated, the MPI_Abort analog).
 
 On a real multi-host TPU pod each host runs its own process via the cluster
 scheduler and ``--np``/``--proc-id`` come from it; this launcher covers the
@@ -28,6 +49,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 
 
 def free_port() -> int:
@@ -43,55 +65,80 @@ def _pump(rank: int, stream, out) -> None:
 
 
 def launch(np_procs: int, cmd: list[str], devices_per_proc: int | None = None,
-           coordinator: str | None = None) -> int:
+           coordinator: str | None = None, timeout: float | None = None,
+           handshake_timeout: float | None = None,
+           max_restarts: int = 0) -> int:
     """Spawn ``np_procs`` copies of ``cmd`` with launcher env; returns the
-    first nonzero exit code (terminating the other ranks), else 0."""
-    import time
-
+    first unrecovered nonzero exit code (terminating the other ranks),
+    124 on ``timeout`` expiry, else 0.  A failed rank is relaunched with
+    the same rank id up to ``max_restarts`` times first."""
     coordinator = coordinator or f"127.0.0.1:{free_port()}"
-    procs: list[subprocess.Popen] = []
+    procs: dict[int, subprocess.Popen] = {}
+    restarts = {rank: 0 for rank in range(np_procs)}
     pumps = []
     rc = 0
+
+    def spawn(rank: int, incarnation: int) -> subprocess.Popen:
+        env = dict(os.environ,
+                   JAX_COORDINATOR_ADDRESS=coordinator,
+                   JAX_NUM_PROCESSES=str(np_procs),
+                   JAX_PROCESS_ID=str(rank),
+                   CME213_INCARNATION=str(incarnation))
+        if handshake_timeout is not None:
+            env["CME213_HANDSHAKE_TIMEOUT"] = str(handshake_timeout)
+        if devices_per_proc:
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count="
+                  f"{devices_per_proc}").strip()
+            env["JAX_PLATFORMS"] = "cpu"
+        p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        t = threading.Thread(target=_pump, args=(rank, p.stdout, sys.stdout),
+                             daemon=True)
+        t.start()
+        pumps.append(t)
+        return p
+
+    deadline = (time.monotonic() + timeout) if timeout else None
     try:
         for rank in range(np_procs):
-            env = dict(os.environ,
-                       JAX_COORDINATOR_ADDRESS=coordinator,
-                       JAX_NUM_PROCESSES=str(np_procs),
-                       JAX_PROCESS_ID=str(rank))
-            if devices_per_proc:
-                env["XLA_FLAGS"] = (
-                    env.get("XLA_FLAGS", "")
-                    + f" --xla_force_host_platform_device_count="
-                      f"{devices_per_proc}").strip()
-                env["JAX_PLATFORMS"] = "cpu"
-            p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
-                                 stderr=subprocess.STDOUT, text=True)
-            procs.append(p)
-            t = threading.Thread(target=_pump,
-                                 args=(rank, p.stdout, sys.stdout),
-                                 daemon=True)
-            t.start()
-            pumps.append(t)
+            procs[rank] = spawn(rank, 0)
 
         # poll ALL ranks: a sequential wait() in rank order would miss a
         # higher rank dying first (e.g. rank 1 crashing while rank 0 blocks
         # in the coordinator handshake forever) and never fail fast
         live = set(range(np_procs))
-        while live:
+        while live and not rc:
             for i in sorted(live):
                 code = procs[i].poll()
                 if code is None:
                     continue
+                if code and restarts[i] < max_restarts:
+                    restarts[i] += 1
+                    print(f"[launcher] rank {i} exited {code}; restarting "
+                          f"(incarnation {restarts[i]}/{max_restarts})",
+                          flush=True)
+                    procs[i] = spawn(i, restarts[i])
+                    continue
                 live.discard(i)
                 if code and not rc:
                     rc = code
-                    for q in procs:  # fail-fast: take survivors down
+                    for q in procs.values():  # fail-fast: take survivors down
                         if q.poll() is None:
                             q.terminate()
-            if live:
+            if deadline is not None and time.monotonic() > deadline and live:
+                print(f"[launcher] timeout after {timeout}s; killing "
+                      f"{len(live)} live rank(s)", flush=True)
+                rc = 124
+                for q in procs.values():
+                    if q.poll() is None:
+                        q.terminate()
+                break
+            if live and not rc:
                 time.sleep(0.05)
     finally:
-        for q in procs:
+        for q in procs.values():
             if q.poll() is None:
                 q.kill()
         for t in pumps:
@@ -109,6 +156,15 @@ def main(argv=None) -> int:
                          "(testing without a pod)")
     ap.add_argument("--coordinator", default=None,
                     help="host:port (default: 127.0.0.1:<free port>)")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="hard wall-clock deadline in seconds for the whole "
+                         "job (returns 124 on expiry)")
+    ap.add_argument("--handshake-timeout", type=float, default=None,
+                    help="coordinator-handshake deadline in seconds, "
+                         "exported to ranks as CME213_HANDSHAKE_TIMEOUT")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="relaunch a failed rank (same rank id) up to this "
+                         "many times before failing the job")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="command to launch (prefix with --)")
     args = ap.parse_args(argv)
@@ -116,7 +172,9 @@ def main(argv=None) -> int:
     if not cmd:
         ap.error("no command given (append: -- python your_script.py)")
     return launch(args.np_procs, cmd, args.devices_per_proc,
-                  args.coordinator)
+                  args.coordinator, timeout=args.timeout,
+                  handshake_timeout=args.handshake_timeout,
+                  max_restarts=args.max_restarts)
 
 
 if __name__ == "__main__":

@@ -38,10 +38,19 @@ def initialize_multihost(coordinator_address: str | None = None,
         return
     if process_id is None:
         process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    kwargs = {}
+    # coordinator-handshake deadline (dist/launch.py --handshake-timeout):
+    # a rank whose coordinator never appears must fail fast — and become
+    # restartable — instead of blocking in the handshake for JAX's
+    # 5-minute default
+    deadline = os.environ.get("CME213_HANDSHAKE_TIMEOUT")
+    if deadline:
+        kwargs["initialization_timeout"] = max(1, int(float(deadline)))
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        **kwargs,
     )
 
 

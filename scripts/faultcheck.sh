@@ -1,0 +1,61 @@
+#!/bin/bash
+# Fault-injection smoke gate — the resilience layer exercised end-to-end
+# under an injected-failure matrix (CPU backend, deterministic faults,
+# no sleeps).  CI runs this next to tier1.sh; humans run it the same way:
+#
+#   bash scripts/faultcheck.sh
+#
+# Asserts, per ISSUE 2:
+#  1. bench harness: run_all under an injected first-attempt sweep failure
+#     exits 0 (the retry recovers) with a POPULATED failures.json — a
+#     single flaky sweep must not zero a capture run;
+#  2. kernel ladder: spmv_scan under an injected pallas-fused failure
+#     completes on a demoted rung with f64-checked-correct results, and
+#     the demotion appears in the structured trace log;
+#  3. launcher: an injected rank kill is survived by --max-restarts 1
+#     (same rank id relaunched), and kills the job without the budget.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+OUT=$(mktemp -d)
+trap 'rm -rf "$OUT"' EXIT
+
+echo "== 1/3 run_all: injected sweep failure -> retry + failures.json"
+CME213_FAULTS="fail:sweep.scan_bandwidth" \
+    python -m cme213_tpu.bench.run_all --quick --out "$OUT" \
+    --only scan_bandwidth
+python - "$OUT" <<'PY'
+import json, sys
+m = json.load(open(sys.argv[1] + "/failures.json"))
+assert m["failed"] == [], m
+assert [r["sweep"] for r in m["retried"]] == ["scan_bandwidth"], m
+print("failures.json populated:", m["retried"][0]["error"])
+PY
+
+echo "== 2/3 spmv ladder: injected pallas failure -> demoted, correct"
+CME213_FAULTS="fail:spmv_scan.pallas-fused" python - <<'PY'
+from cme213_tpu.apps import spmv_scan as sp
+from cme213_tpu.core import trace
+prob = sp.generate_problem(4096, 64, 63, iters=4, seed=0)
+out = sp.run_spmv_scan(prob, kernel="pallas-fused")
+served = trace.events("served")[-1]
+assert served["demoted"] and served["rung"] == "blocked", served
+errs = sp.external_check(prob, out)
+assert errs["rel_l2"] < 1e-4, errs
+print("demoted to", served["rung"], "rel_l2", errs["rel_l2"])
+PY
+
+echo "== 3/3 launcher: injected rank kill survived by --max-restarts 1"
+CME213_FAULTS="rankkill:1:0" python -m cme213_tpu.dist.launch \
+    --np 2 --max-restarts 1 --timeout 120 -- \
+    python -c "import os; from cme213_tpu.core import faults; \
+faults.maybe_kill_rank(); print('rank', os.environ['JAX_PROCESS_ID'], 'ok')"
+if CME213_FAULTS="rankkill:1:0" python -m cme213_tpu.dist.launch \
+    --np 2 --timeout 120 -- \
+    python -c "from cme213_tpu.core import faults; faults.maybe_kill_rank()" \
+    2>/dev/null; then
+  echo "ERROR: rank kill without restart budget should fail the job" >&2
+  exit 1
+fi
+
+echo "faultcheck OK"

@@ -1,0 +1,257 @@
+"""Integration: the resilience layer wired through the solvers, the
+launcher and the bench harness, exercised under deterministic injected
+faults (no sleeps, no timing races — every fault fires on an exact call
+count).
+
+Covers the ISSUE-2 acceptance paths: ladder demotion under injected pallas
+failure with correct results on the demoted rung, resume-after-NaN-abort
+bitwise-matching an uninterrupted run, corrupt-checkpoint quarantine
+(tests/test_resilience.py), a CPU-only rank-kill/restart through
+``dist.launch``, and ``bench.run_all`` surviving an injected sweep failure
+with a populated ``failures.json``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cme213_tpu.core import faults, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    trace.clear_events()
+    yield
+    faults.reset()
+
+
+# --------------------------------------------------------- spmv ladder
+
+def test_spmv_injected_pallas_failure_demotes_to_blocked():
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(512, 16, 15, iters=4, seed=0)
+    with faults.injected("fail:spmv_scan.pallas-fused"):
+        out = sp.run_spmv_scan(prob, kernel="pallas-fused")
+    served = trace.events("served")[-1]
+    assert served["op"] == "spmv_scan"
+    assert served["rung"] == "blocked" and served["demoted"]
+    assert served["failed_rungs"] == ["pallas-fused"]
+    # the demoted rung's result is still correct against the f64 golden
+    errs = sp.external_check(prob, out)
+    assert errs["rel_l2"] < 1e-4, errs
+
+
+def test_spmv_double_failure_lands_on_flat():
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(256, 8, 7, iters=3, seed=1)
+    with faults.injected("fail:spmv_scan.pallas,fail:spmv_scan.blocked"):
+        out = sp.run_spmv_scan(prob, kernel="pallas")
+    assert trace.events("served")[-1]["rung"] == "flat"
+    assert sp.external_check(prob, out)["rel_l2"] < 1e-4
+
+
+def test_spmv_no_faults_serves_requested_rung():
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(256, 8, 7, iters=3, seed=2)
+    out = sp.run_spmv_scan(prob, kernel="blocked")
+    served = trace.events("served")[-1]
+    assert served["rung"] == "blocked" and not served["demoted"]
+    assert not trace.events("rung-failed")
+    assert sp.external_check(prob, out)["rel_l2"] < 1e-4
+
+
+def test_spmv_fallback_off_is_failfast():
+    from cme213_tpu.apps import spmv_scan as sp
+    from cme213_tpu.core import FrameworkError
+
+    prob = sp.generate_problem(128, 4, 3, iters=2, seed=3)
+    with faults.injected("fail:spmv_scan.flat"):
+        with pytest.raises(FrameworkError):
+            sp.run_spmv_scan(prob, kernel="flat", fallback=False)
+
+
+def test_spmv_checkpointed_nan_resume_bitwise(tmp_path):
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(512, 16, 15, iters=6, seed=4)
+    with faults.injected("nan:spmv_scan:2"):
+        out_faulted = sp.run_spmv_scan_checkpointed(
+            prob, str(tmp_path / "f.npz"), every=2, kernel="flat")
+    assert trace.events("checkpoint-rollback"), "rollback must have fired"
+    out_clean = sp.run_spmv_scan_checkpointed(
+        prob, str(tmp_path / "c.npz"), every=2, kernel="flat")
+    # resume-and-retry is bitwise-invisible: deterministic chunking
+    np.testing.assert_array_equal(out_faulted, out_clean)
+
+
+# --------------------------------------------------------- heat ladder
+
+def test_heat_pipeline_injected_failure_demotes_bitwise():
+    import jax.numpy as jnp
+
+    from cme213_tpu.config import SimParams
+    from cme213_tpu.grid import make_initial_grid
+    from cme213_tpu.ops import run_heat
+    from cme213_tpu.ops.stencil_pipeline import run_heat_resilient
+
+    p = SimParams(nx=24, ny=24, order=2, iters=4)
+    u0 = make_initial_grid(p, dtype=jnp.float32)
+    ref = np.asarray(run_heat(jnp.array(u0), p.iters, p.order, p.xcfl,
+                              p.ycfl))
+    with faults.injected("fail:heat.pipeline"):
+        res = run_heat_resilient(jnp.array(u0), p.iters, p.order, p.xcfl,
+                                 p.ycfl, p.bc, k=1, interpret=True)
+    assert res.rung == "pipeline2d" and res.demoted
+    np.testing.assert_array_equal(np.asarray(res.value), ref)
+
+    # both Pallas rungs dead -> the XLA formulation serves, still bitwise
+    with faults.injected("fail:heat.pipeline,fail:heat.pipeline2d"):
+        res = run_heat_resilient(jnp.array(u0), p.iters, p.order, p.xcfl,
+                                 p.ycfl, p.bc, k=1, interpret=True)
+    assert res.rung == "xla"
+    assert [f.rung for f in res.failures] == ["pipeline", "pipeline2d"]
+    np.testing.assert_array_equal(np.asarray(res.value), ref)
+
+
+def test_heat_single_driver_survives_injected_pallas_failure():
+    from cme213_tpu.apps.heat2d import run_single
+    from cme213_tpu.config import SimParams
+
+    p = SimParams(nx=24, ny=24, order=2, iters=4)
+    with faults.injected("fail:heat.pipeline,fail:heat.pipeline2d"):
+        res = run_single(p, check_cpu=True)
+    # ULP-vs-golden checks still pass on the demoted rung
+    assert res.ok
+    assert any("pallas->xla" in r for r in res.reports), res.reports
+
+
+def test_heat_checkpointed_nan_resume_bitwise(tmp_path):
+    from cme213_tpu.apps.heat2d import run_heat_checkpointed
+    from cme213_tpu.config import SimParams
+
+    p = SimParams(nx=20, ny=20, order=4, iters=12)
+    with faults.injected("nan:heat2d:2"):
+        out_faulted = run_heat_checkpointed(p, str(tmp_path / "f.npz"),
+                                            every=4)
+    out_clean = run_heat_checkpointed(p, str(tmp_path / "c.npz"), every=4)
+    np.testing.assert_array_equal(out_faulted, out_clean)
+
+
+# --------------------------------------------------------- launcher
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a rank body that needs no jax: report rank+incarnation, honor rankkill
+_RANK_BODY = (
+    f"import sys; sys.path.insert(0, {_REPO!r}); import os; "
+    "from cme213_tpu.core import faults; faults.maybe_kill_rank(); "
+    "print('rank', os.environ['JAX_PROCESS_ID'], "
+    "'incarnation', faults.incarnation(), 'ok')")
+
+
+def test_launch_rank_kill_restart_survives(monkeypatch, capsys):
+    from cme213_tpu.dist.launch import launch
+
+    monkeypatch.setenv("CME213_FAULTS", "rankkill:1:0")
+    rc = launch(2, [sys.executable, "-c", _RANK_BODY], max_restarts=1)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "injected kill: rank 1" in out
+    assert "restarting (incarnation 1/1)" in out
+    assert "rank 1 incarnation 1 ok" in out  # same rank id relaunched
+    assert "rank 0 incarnation 0 ok" in out
+
+
+def test_launch_rank_kill_without_restart_budget_fails(monkeypatch):
+    from cme213_tpu.dist.launch import launch
+
+    monkeypatch.setenv("CME213_FAULTS", "rankkill:1:0")
+    rc = launch(2, [sys.executable, "-c", _RANK_BODY], max_restarts=0)
+    assert rc == faults.KILL_EXIT
+
+
+def test_launch_timeout_kills_stuck_job():
+    from cme213_tpu.dist.launch import launch
+
+    t0 = time.monotonic()
+    rc = launch(1, [sys.executable, "-c", "import time; time.sleep(60)"],
+                timeout=1.0)
+    assert rc == 124
+    assert time.monotonic() - t0 < 30
+
+
+def test_launch_exports_handshake_deadline(capsys):
+    from cme213_tpu.dist.launch import launch
+
+    rc = launch(1, [sys.executable, "-c",
+                    "import os; print('HS', "
+                    "os.environ['CME213_HANDSHAKE_TIMEOUT'], "
+                    "os.environ['CME213_INCARNATION'])"],
+                handshake_timeout=7.5)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "HS 7.5 0" in out
+
+
+def test_multihost_handshake_deadline_reaches_initialize(monkeypatch):
+    import jax
+
+    from cme213_tpu.dist.multihost import initialize_multihost
+
+    seen = {}
+
+    def fake_initialize(**kwargs):
+        seen.update(kwargs)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+    monkeypatch.setenv("CME213_HANDSHAKE_TIMEOUT", "12")
+    initialize_multihost(coordinator_address="127.0.0.1:1234",
+                         num_processes=2, process_id=0)
+    assert seen["initialization_timeout"] == 12
+    assert seen["process_id"] == 0
+
+
+# --------------------------------------------------------- bench harness
+
+def test_run_all_retries_injected_sweep_failure(tmp_path):
+    from cme213_tpu.bench.run_all import main
+
+    with faults.injected("fail:sweep.scan_bandwidth"):
+        rc = main(["--quick", "--out", str(tmp_path),
+                   "--only", "scan_bandwidth"])
+    assert rc == 0  # the retry recovered the run
+    assert (tmp_path / "scan_bandwidth.csv").exists()
+    manifest = json.loads((tmp_path / "failures.json").read_text())
+    assert manifest["failed"] == []
+    assert [r["sweep"] for r in manifest["retried"]] == ["scan_bandwidth"]
+    assert manifest["retried"][0]["error"] == "InjectedFault"
+
+
+def test_run_all_double_failure_is_recorded_and_nonzero(tmp_path):
+    from cme213_tpu.bench.run_all import main
+
+    with faults.injected("fail:sweep.scan_bandwidth:1:2"):
+        rc = main(["--quick", "--out", str(tmp_path),
+                   "--only", "scan_bandwidth"])
+    assert rc == 1  # both attempts failed: the capture layer must see it
+    assert not (tmp_path / "scan_bandwidth.csv").exists()
+    manifest = json.loads((tmp_path / "failures.json").read_text())
+    assert [r["sweep"] for r in manifest["failed"]] == ["scan_bandwidth"]
+    assert [r["sweep"] for r in manifest["retried"]] == ["scan_bandwidth"]
+
+
+def test_run_all_clean_run_writes_empty_manifest(tmp_path):
+    from cme213_tpu.bench.run_all import main
+
+    rc = main(["--quick", "--out", str(tmp_path),
+               "--only", "scan_bandwidth"])
+    assert rc == 0
+    manifest = json.loads((tmp_path / "failures.json").read_text())
+    assert manifest == {"failed": [], "retried": []}

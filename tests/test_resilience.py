@@ -1,0 +1,339 @@
+"""Core resilience primitives: fault-plan parsing, failure classification,
+deterministic retry, the fallback-ladder combinator, structured check_op
+records, and the hardened checkpoint layer (checksums, quarantine,
+last-good retention, pytree states, NaN rollback)."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from cme213_tpu.core import (FailureKind, FrameworkError, NonFiniteError,
+                             RetryPolicy, all_finite, check_op,
+                             classify_failure, clear_events, events,
+                             with_fallback)
+from cme213_tpu.core import faults
+from cme213_tpu.core.checkpoint import (CORRUPT_SUFFIX, PREV_SUFFIX,
+                                        load_checkpoint, run_with_checkpoints,
+                                        save_checkpoint,
+                                        save_state_checkpoint)
+
+
+# ------------------------------------------------------------ fault plans
+
+def test_fault_spec_parsing():
+    plan = faults.FaultPlan.parse(
+        "fail:op.a:2:3, nan:solve, ckpt:truncate:4, rankkill:1:5")
+    kinds = [(c.kind, c.op, c.nth, c.count) for c in plan.clauses]
+    assert kinds == [("fail", "op.a", 2, 3), ("nan", "solve", 1, 1),
+                     ("ckpt", "truncate", 4, 1), ("rankkill", "1", 5, 1)]
+
+
+@pytest.mark.parametrize("bad", ["explode:x", "fail", "ckpt:corrupt",
+                                 "fail:op:notanint"])
+def test_fault_spec_errors(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultPlan.parse(bad)
+
+
+def test_maybe_fail_nth_and_count():
+    with faults.injected("fail:op.x:2:2"):
+        faults.maybe_fail("op.x")                       # call 1: clean
+        with pytest.raises(faults.InjectedFault):
+            faults.maybe_fail("op.x")                   # call 2: fires
+        with pytest.raises(faults.InjectedFault):
+            faults.maybe_fail("op.x")                   # call 3: window
+        faults.maybe_fail("op.x")                       # call 4: clean
+        faults.maybe_fail("op.other")                   # other op untouched
+
+
+def test_disabled_plan_is_a_noop(monkeypatch):
+    monkeypatch.delenv("CME213_FAULTS", raising=False)
+    faults.reset()
+    faults.maybe_fail("anything")
+    state = np.ones(3)
+    assert faults.maybe_poison("anything", state) is state
+
+
+def test_maybe_poison_pytree():
+    with faults.injected("nan:solve:2"):
+        state = {"grid": np.ones(4), "halo": np.zeros(2, np.int32)}
+        out1 = faults.maybe_poison("solve", state)      # call 1: clean
+        assert np.isfinite(out1["grid"]).all()
+        out2 = faults.maybe_poison("solve", state)      # call 2: poisoned
+        assert np.isnan(out2["grid"]).any()
+        # int leaves are never poisoned; original state never mutated
+        assert np.isfinite(state["grid"]).all()
+        np.testing.assert_array_equal(out2["halo"], state["halo"])
+
+
+# ------------------------------------------------------------ classification
+
+@pytest.mark.parametrize("exc,kind", [
+    (NonFiniteError("nan state"), FailureKind.NUMERIC),
+    (FloatingPointError("overflow"), FailureKind.NUMERIC),
+    (RuntimeError("output contains NaN values"), FailureKind.NUMERIC),
+    (NotImplementedError("no lowering rule"), FailureKind.COMPILE),
+    (RuntimeError("Mosaic failed to compile the kernel"),
+     FailureKind.COMPILE),
+    (ValueError("unsupported op in lowering"), FailureKind.COMPILE),
+    (faults.InjectedFault("injected failure in op"), FailureKind.RUNTIME),
+    (OSError("connection reset"), FailureKind.RUNTIME),
+])
+def test_classify_failure(exc, kind):
+    assert classify_failure(exc) == kind
+
+
+def test_classify_unwraps_framework_error():
+    try:
+        try:
+            raise NotImplementedError("no lowering rule")
+        except NotImplementedError as e:
+            raise FrameworkError("error in op") from e
+    except FrameworkError as fe:
+        assert classify_failure(fe) == FailureKind.COMPILE
+
+
+def test_all_finite():
+    import jax.numpy as jnp
+
+    assert all_finite({"a": jnp.ones(3), "b": (np.arange(4),)})
+    assert all_finite(np.arange(5, dtype=np.int32))  # ints trivially finite
+    bad = {"a": np.array([1.0, np.nan])}
+    assert not all_finite(bad)
+
+
+# ------------------------------------------------------------ retry policy
+
+def test_retry_policy_deterministic_backoff():
+    sleeps = []
+    pol = RetryPolicy(max_retries=3, base_delay_s=0.01, multiplier=2.0,
+                      sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "done"
+
+    assert pol.run(flaky) == "done"
+    assert sleeps == [0.01, 0.02]  # geometric, no jitter
+
+
+def test_retry_policy_does_not_retry_compile_failures():
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise NotImplementedError("no lowering rule")
+
+    with pytest.raises(NotImplementedError):
+        RetryPolicy(max_retries=3, sleep=lambda s: None).run(broken)
+    assert calls["n"] == 1
+
+
+def test_retry_policy_exhausts():
+    def broken():
+        raise RuntimeError("always")
+
+    with pytest.raises(RuntimeError):
+        RetryPolicy(max_retries=2, sleep=lambda s: None).run(broken)
+
+
+# ------------------------------------------------------------ fallback ladder
+
+def test_with_fallback_serves_first_healthy_rung():
+    clear_events()
+    res = with_fallback("op", [("a", lambda: "A"), ("b", lambda: "B")])
+    assert (res.value, res.rung, res.demoted) == ("A", "a", False)
+    served = events("served")[-1]
+    assert served["rung"] == "a" and not served["demoted"]
+
+
+def test_with_fallback_demotes_and_records():
+    clear_events()
+
+    def dead():
+        raise RuntimeError("Mosaic failed to compile")
+
+    res = with_fallback("op", [("pallas", dead), ("xla", lambda: 42)])
+    assert res.value == 42 and res.rung == "xla" and res.demoted
+    assert [f.rung for f in res.failures] == ["pallas"]
+    assert res.failures[0].kind == FailureKind.COMPILE
+    rec = events("rung-failed")[-1]
+    assert rec["op"] == "op" and rec["rung"] == "pallas"
+    assert events("served")[-1]["failed_rungs"] == ["pallas"]
+
+
+def test_with_fallback_injected_fault_demotes():
+    clear_events()
+    ran = []
+    with faults.injected("fail:op.pallas"):
+        res = with_fallback("op", [
+            ("pallas", lambda: ran.append("pallas") or "P"),
+            ("flat", lambda: ran.append("flat") or "F")])
+    # the injected fault fires BEFORE the rung runs — the pallas thunk
+    # must never execute, exactly like a launch failure
+    assert ran == ["flat"] and res.rung == "flat"
+
+
+def test_with_fallback_all_rungs_dead():
+    def dead():
+        raise RuntimeError("boom")
+
+    with pytest.raises(FrameworkError, match="all 2 rungs"):
+        with_fallback("op", [("a", dead), ("b", dead)])
+
+
+# ------------------------------------------------------------ check_op
+
+def test_check_op_success_feeds_timer():
+    import jax.numpy as jnp
+
+    from cme213_tpu.core import PhaseTimer
+
+    t = PhaseTimer()
+    out = check_op("fine", jnp.ones(8), timer=t)
+    assert out.shape == (8,)
+    assert t.records[-1].label == "fine" and t.records[-1].ms >= 0
+
+
+def test_check_op_failure_emits_structured_record(monkeypatch):
+    import cme213_tpu.core.errors as errors_mod
+
+    def boom(_):
+        raise RuntimeError("device exploded")
+
+    monkeypatch.setattr(errors_mod.jax, "block_until_ready", boom)
+    clear_events()
+    with pytest.raises(FrameworkError, match="error in bad op") as ei:
+        check_op("bad op", np.ones(3))
+    rec = events("op-failure")[-1]
+    assert rec["op"] == "bad op" and rec["error"] == "RuntimeError"
+    assert rec["ms"] >= 0
+    assert ei.value.record is rec
+
+
+# ------------------------------------------------------------ checkpoints
+
+def test_checkpoint_corrupt_quarantine(tmp_path):
+    p = str(tmp_path / "ck.npz")
+    save_checkpoint(p, 3, state=np.arange(6.0))
+    data = open(p, "rb").read()
+    open(p, "wb").write(data[: len(data) // 2])  # torn write
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert load_checkpoint(p) is None
+    assert os.path.exists(p + CORRUPT_SUFFIX)
+    assert not os.path.exists(p)
+    assert any("quarantined" in str(x.message) for x in w)
+
+
+def test_checkpoint_foreign_npz_quarantine(tmp_path):
+    p = str(tmp_path / "foreign.npz")
+    np.savez(p, a=np.arange(3))  # no __step
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        assert load_checkpoint(p) is None
+    assert os.path.exists(p + CORRUPT_SUFFIX)
+
+
+def test_checkpoint_checksum_mismatch_falls_back_to_prev(tmp_path):
+    p = str(tmp_path / "ck.npz")
+    save_checkpoint(p, 1, state=np.arange(4.0))
+    save_checkpoint(p, 2, state=np.arange(4.0) + 1)
+    assert os.path.exists(p + PREV_SUFFIX)
+    # flip payload bytes inside the zip without breaking the container:
+    # rewrite the current file as a VALID npz whose __crc doesn't match
+    with np.load(p) as z:
+        step, crc = int(z["__step"]), z["__crc"]
+        arr = z["state"]
+    np.savez(p, __step=np.int64(step), __crc=crc, state=arr + 100.0)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        loaded = load_checkpoint(p)
+    assert loaded is not None
+    step, arrays = loaded
+    assert step == 1  # recovered from .prev
+    np.testing.assert_array_equal(arrays["state"], np.arange(4.0))
+    assert any("checksum" in str(x.message) for x in w)
+
+
+def test_checkpoint_injected_truncation_recovers(tmp_path):
+    p = str(tmp_path / "ck.npz")
+    with faults.injected("ckpt:truncate:2"):
+        save_checkpoint(p, 1, state=np.zeros(3))
+        save_checkpoint(p, 2, state=np.ones(3))  # this write is torn
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        step, arrays = load_checkpoint(p)
+    assert step == 1
+    np.testing.assert_array_equal(arrays["state"], np.zeros(3))
+
+
+def test_pytree_state_roundtrip(tmp_path):
+    p = str(tmp_path / "ck.npz")
+    state = {"grid": np.arange(8.0).reshape(2, 4), "halo": (np.ones(3),)}
+    save_state_checkpoint(p, 5, state)
+    from cme213_tpu.core.checkpoint import _unflatten_state
+
+    step, arrays = load_checkpoint(p)
+    restored = _unflatten_state(arrays)
+    assert step == 5
+    np.testing.assert_array_equal(restored["grid"], state["grid"])
+    np.testing.assert_array_equal(restored["halo"][0], state["halo"][0])
+
+
+def test_run_with_checkpoints_pytree_resume(tmp_path):
+    p = str(tmp_path / "run.npz")
+    calls = []
+
+    def step(state, k):
+        calls.append(k)
+        return {"grid": state["grid"] + k, "halo": state["halo"] * 1}
+
+    init = {"grid": np.zeros(4), "halo": np.arange(2)}
+    out = run_with_checkpoints(step, init, 10, p, every=3)
+    np.testing.assert_array_equal(out["grid"], np.full(4, 10.0))
+    assert calls == [3, 3, 3, 1]
+    calls.clear()
+    out2 = run_with_checkpoints(step, init, 10, p, every=3)
+    np.testing.assert_array_equal(out2["grid"], np.full(4, 10.0))
+    np.testing.assert_array_equal(out2["halo"], np.arange(2))
+    assert calls == []  # resumed from the final checkpoint
+
+
+def test_run_with_checkpoints_nan_rollback_bitwise(tmp_path):
+    def step(state, k):
+        return state + k
+
+    with faults.injected("nan:solve:2"):
+        out = run_with_checkpoints(step, np.zeros(3), 10,
+                                   str(tmp_path / "a.npz"), every=3,
+                                   guard=all_finite, op="solve")
+    ref = run_with_checkpoints(step, np.zeros(3), 10,
+                               str(tmp_path / "b.npz"), every=3,
+                               guard=all_finite, op="clean")
+    np.testing.assert_array_equal(out, ref)
+    assert np.isfinite(out).all()
+
+
+def test_run_with_checkpoints_first_chunk_rollback(tmp_path):
+    # a blow-up in the FIRST chunk rolls back to the step-0 checkpoint
+    with faults.injected("nan:solve:1"):
+        out = run_with_checkpoints(lambda s, k: s + k, np.zeros(3), 6,
+                                   str(tmp_path / "a.npz"), every=2,
+                                   guard=all_finite, op="solve")
+    np.testing.assert_array_equal(out, np.full(3, 6.0))
+
+
+def test_run_with_checkpoints_retry_budget(tmp_path):
+    # every chunk poisoned: the bounded rollback budget must trip
+    with faults.injected("nan:solve,nan:solve:2,nan:solve:3"):
+        with pytest.raises(NonFiniteError):
+            run_with_checkpoints(lambda s, k: s + k, np.zeros(3), 6,
+                                 str(tmp_path / "a.npz"), every=2,
+                                 guard=all_finite, op="solve",
+                                 max_retries=1)
