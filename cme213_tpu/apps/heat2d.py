@@ -171,13 +171,68 @@ def run_distributed(params: SimParams, num_devices: int | None = None,
     return out
 
 
+def run_distributed_supervised(params: SimParams,
+                               num_devices: int | None = None,
+                               ckpt_dir: str | None = None,
+                               ckpt_every: int = 0,
+                               resume: bool | None = None,
+                               save_files: bool = False,
+                               out_dir: str = ".") -> np.ndarray:
+    """hw5 main under gang supervision: the worker entry a supervised
+    launcher gang runs (``dist.launch --stall-timeout ... -- python -m
+    cme213_tpu.apps.heat2d params.in --distributed --supervised``).
+
+    Checkpoint plumbing defaults from the launcher's exported env
+    (``CME213_CKPT_DIR`` / ``CME213_CKPT_EVERY`` / ``CME213_RESUME``);
+    heartbeats wire up automatically when ``CME213_HEARTBEAT_DIR`` is set.
+    Joins the multi-process runtime first when launched with real ranks.
+    Runs the sync path (the bitwise-reproducible decomposition-invariant
+    scheme), committing an epoch every ``ckpt_every`` iterations.
+    """
+    import os
+
+    from ..dist.heat import run_distributed_heat_supervised
+    from ..dist.multihost import initialize_multihost
+    from ..dist.supervisor import heartbeat_from_env, supervised_env_config
+
+    cfg = supervised_env_config()
+    ckpt_dir = ckpt_dir or cfg["ckpt_dir"]
+    if not ckpt_dir:
+        raise ValueError("supervised run needs a checkpoint directory "
+                         "(--ckpt-dir or CME213_CKPT_DIR)")
+    ckpt_every = ckpt_every or cfg["ckpt_every"]
+    resume = cfg["resume"] if resume is None else resume
+    if int(os.environ.get("JAX_NUM_PROCESSES", "1")) > 1:
+        initialize_multihost()
+    mesh = mesh_for_method(params.grid_method, num_devices)
+    timer = PhaseTimer(verbose=True)
+    with timer.phase("supervised distributed computation"):
+        out = run_distributed_heat_supervised(
+            params, mesh, ckpt_dir, ckpt_every=ckpt_every, resume=resume,
+            heartbeat=heartbeat_from_env())
+    print(f"supervised solve complete: {params.iters} iters, "
+          f"epoch commits in {ckpt_dir}")
+    if save_files:
+        save_grid_to_file(out, f"{out_dir}/grid_final.txt")
+    return out
+
+
 def main(argv: list[str]) -> int:
     paths = [a for a in argv[1:] if not a.startswith("--")]
     path = paths[0] if paths else "params.in"
     distributed = "--distributed" in argv
+    supervised = "--supervised" in argv
     local_kernel = next((a.split("=", 1)[1] for a in argv
                          if a.startswith("--local-kernel=")), "xla")
-    params = SimParams.from_file(path, distributed=distributed)
+    ckpt_dir = next((a.split("=", 1)[1] for a in argv
+                     if a.startswith("--ckpt-dir=")), None)
+    ckpt_every = int(next((a.split("=", 1)[1] for a in argv
+                           if a.startswith("--ckpt-every=")), "0"))
+    params = SimParams.from_file(path, distributed=distributed or supervised)
+    if supervised:
+        run_distributed_supervised(params, ckpt_dir=ckpt_dir,
+                                   ckpt_every=ckpt_every, save_files=True)
+        return 0
     if distributed:
         run_distributed(params, save_files=True, local_kernel=local_kernel)
         return 0

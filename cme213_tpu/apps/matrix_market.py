@@ -15,41 +15,146 @@ import gzip
 
 import numpy as np
 
+from ..core.errors import data_error
 from .spmv_scan import Problem
 
 
 def read_matrix_market(path: str):
-    """Minimal MatrixMarket coordinate parser.
+    """Minimal MatrixMarket coordinate parser, hardened at the boundary.
 
     Supports ``matrix coordinate (real|integer|pattern) (general|symmetric)``.
     Returns (rows, cols, values, shape) with 0-based indices, symmetric
     entries expanded.
+
+    Every ingestion invariant is checked here — header/banner shape, the
+    size line, entry count vs the declared nnz (truncated downloads), the
+    per-entry column arity, 1-based index bounds, value finiteness — and a
+    violation raises a structured :class:`core.errors.DataValidationError`
+    (with a ``data-validation`` trace event) instead of shipping garbage
+    into the SpMV engine, where a bad index would surface as a silent
+    out-of-bounds gather clamp.
     """
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rt") as f:
         header = f.readline().strip().lower().split()
         if header[:2] != ["%%matrixmarket", "matrix"]:
-            raise ValueError("not a MatrixMarket matrix file")
+            raise data_error(path, "banner",
+                             "not a MatrixMarket matrix file")
+        if len(header) < 5:
+            raise data_error(path, "banner",
+                             f"truncated banner ({' '.join(header)!r})")
         if header[2] != "coordinate":
-            raise ValueError("only coordinate format supported")
+            raise data_error(path, "format",
+                             f"only coordinate format supported, "
+                             f"got {header[2]!r}")
         field, sym = header[3], header[4]
+        if field not in ("real", "integer", "pattern"):
+            raise data_error(path, "field", f"unsupported field {field!r}")
+        if sym not in ("general", "symmetric"):
+            raise data_error(path, "symmetry",
+                             f"unsupported symmetry {sym!r}")
         line = f.readline()
         while line.startswith("%"):
             line = f.readline()
-        nr, nc, nnz = (int(v) for v in line.split())
-        data = np.loadtxt(f, ndmin=2)
+        try:
+            nr, nc, nnz = (int(v) for v in line.split())
+        except ValueError as e:
+            raise data_error(path, "size-line",
+                             f"bad size line {line.strip()!r}: {e}") from e
+        if nr <= 0 or nc <= 0 or nnz < 0:
+            raise data_error(path, "size-line",
+                             f"non-positive dims/count ({nr}, {nc}, {nnz})")
+        try:
+            data = np.loadtxt(f, ndmin=2)
+        except ValueError as e:
+            raise data_error(path, "entries",
+                             f"unparseable entry data: {e}") from e
+    want_cols = 2 if field == "pattern" else 3
+    if nnz == 0:
+        data = data.reshape(0, want_cols)
+    if data.shape[0] != nnz:
+        raise data_error(path, "entry-count",
+                         f"header declares {nnz} entries, file holds "
+                         f"{data.shape[0]} (truncated or padded file)")
+    if nnz and data.shape[1] < want_cols:
+        raise data_error(path, "entry-arity",
+                         f"{field} entries need {want_cols} columns, "
+                         f"got {data.shape[1]}")
     rows = data[:, 0].astype(np.int64) - 1
     cols = data[:, 1].astype(np.int64) - 1
+    if nnz and (not np.all(data[:, :2] == np.floor(data[:, :2]))):
+        raise data_error(path, "index-integrality",
+                         "fractional row/col index")
+    if ((rows < 0) | (rows >= nr)).any() or ((cols < 0) | (cols >= nc)).any():
+        raise data_error(path, "index-bounds",
+                         f"row/col index outside 1..{nr} x 1..{nc}")
     if field == "pattern":
         vals = np.ones(rows.shape[0], dtype=np.float32)
     else:
         vals = data[:, 2].astype(np.float32)
+        if not np.isfinite(vals).all():
+            raise data_error(path, "value-finiteness",
+                             "non-finite (nan/inf) matrix value")
     if sym == "symmetric":
+        if ((rows < cols).any()):
+            raise data_error(path, "symmetry",
+                             "symmetric file stores an upper-triangle "
+                             "entry (lower triangle expected)")
         off = rows != cols
         rows, cols = (np.concatenate([rows, cols[off]]),
                       np.concatenate([cols, rows[off]]))
         vals = np.concatenate([vals, vals[off]])
     return rows, cols, vals, (nr, nc)
+
+
+def coo_to_csr(rows, cols, vals, shape):
+    """(indptr, indices, data) in canonical CSR (row-major, columns sorted
+    within each row) from validated COO triplets."""
+    nr, _ = shape
+    order = np.lexsort((cols, rows))
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    indptr = np.zeros(nr + 1, dtype=np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    np.cumsum(indptr, out=indptr)
+    return indptr, cols, vals
+
+
+def validate_csr(indptr, indices, data, shape, source: str = "csr") -> None:
+    """CSR structural invariants, raising structured
+    :class:`DataValidationError` on the first violation: ``indptr`` is
+    monotone non-decreasing with ``indptr[0] == 0`` and
+    ``indptr[-1] == nnz``; column indices are in-bounds; values finite."""
+    nr, nc = shape
+    if indptr.shape[0] != nr + 1:
+        raise data_error(source, "indptr-length",
+                         f"len(indptr)={indptr.shape[0]}, rows+1={nr + 1}")
+    if indptr[0] != 0:
+        raise data_error(source, "indptr-origin",
+                         f"indptr[0]={indptr[0]} != 0")
+    if (np.diff(indptr) < 0).any():
+        raise data_error(source, "indptr-monotone",
+                         "indptr decreases (overlapping rows)")
+    if indptr[-1] != indices.shape[0] or indices.shape[0] != data.shape[0]:
+        raise data_error(source, "nnz-consistency",
+                         f"indptr[-1]={indptr[-1]}, len(indices)="
+                         f"{indices.shape[0]}, len(data)={data.shape[0]}")
+    if indices.size and (((indices < 0) | (indices >= nc)).any()):
+        raise data_error(source, "column-bounds",
+                         f"column index outside 0..{nc - 1}")
+    if not np.isfinite(data).all():
+        raise data_error(source, "value-finiteness",
+                         "non-finite (nan/inf) CSR value")
+
+
+def csr_from_mtx(path: str):
+    """Load ``path`` straight to validated canonical CSR:
+    ``(indptr, indices, data, shape)``.  Both the COO-level ingestion
+    checks (``read_matrix_market``) and the CSR structural invariants
+    (``validate_csr``) have passed when this returns."""
+    rows, cols, vals, shape = read_matrix_market(path)
+    indptr, indices, data = coo_to_csr(rows, cols, vals, shape)
+    validate_csr(indptr, indices, data, shape, source=path)
+    return indptr, indices, data, shape
 
 
 def gr_30_30_mtx() -> str:

@@ -371,42 +371,11 @@ def run_spmv_scan_distributed(prob: Problem, mesh, dtype=jnp.float32,
     O(n/d) once shards cross the threshold.  Pads to a shard multiple
     with zero-valued, own-segment tail elements (they never affect real
     segments)."""
-    from ..dist.mesh import shard_map
-    from ..dist.scan import _local_with_carry  # sharded kernel
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..dist.scan import make_iterated_sharded_scan
 
     prob.validate()
-    axis = mesh.axis_names[0]
-    nshards = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
-    n = prob.n
-    padded = -(-n // nshards) * nshards
-    a = np.zeros(padded, dtype=np.float32)
-    a[:n] = prob.a
-    xx = np.zeros(padded, dtype=np.float32)
-    xx[:n] = prob.xx
-    flags = np.zeros(padded, dtype=np.int32)
-    flags[prob.s[:-1]] = 1
-    if padded > n:
-        flags[n] = 1  # quarantine the tail in its own segment
-
-    spec = P(axis)
-    sharding = NamedSharding(mesh, spec)
-    a_d = jax.device_put(jnp.asarray(a, dtype), sharding)
-    xx_d = jax.device_put(jnp.asarray(xx, dtype), sharding)
-    fl_d = jax.device_put(jnp.asarray(flags), sharding)
-
-    @partial(jax.jit, static_argnames=("iters",), donate_argnums=(0,))
-    def iterate(a_d, xx_d, fl_d, iters: int):
-        def sharded(a_blk, xx_blk, fl_blk):
-            def body(_, v):
-                return _local_with_carry(v * xx_blk, fl_blk,
-                                         axis_name=axis, axis_size=nshards)
-
-            return jax.lax.fori_loop(0, iters, body, a_blk)
-
-        return shard_map(sharded, mesh=mesh,
-                         in_specs=(spec, spec, spec),
-                         out_specs=spec)(a_d, xx_d, fl_d)
+    a_d, xx_d, fl_d, n = _shard_problem(prob, mesh, dtype)
+    iterate = make_iterated_sharded_scan(mesh)
 
     timer = timer or PhaseTimer()
     iterate(jnp.zeros_like(a_d), xx_d, fl_d, prob.iters).block_until_ready()
@@ -414,6 +383,98 @@ def run_spmv_scan_distributed(prob: Problem, mesh, dtype=jnp.float32,
         out = iterate(a_d, xx_d, fl_d, prob.iters)
         ph.block(out)
     return np.asarray(out)[:n]
+
+
+def _shard_problem(prob: Problem, mesh, dtype, values: np.ndarray | None = None):
+    """Pad + shard the problem state over the mesh's first axis: returns
+    ``(a, xx, flags, n)`` device arrays.  ``values`` overrides the value
+    vector (the resume path re-shards a committed mid-solve state)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    nshards = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    n = prob.n
+    padded = -(-n // nshards) * nshards
+    a = np.zeros(padded, dtype=np.float32)
+    a[:n] = prob.a if values is None else values
+    xx = np.zeros(padded, dtype=np.float32)
+    xx[:n] = prob.xx
+    flags = np.zeros(padded, dtype=np.int32)
+    flags[prob.s[:-1]] = 1
+    if padded > n:
+        flags[n] = 1  # quarantine the tail in its own segment
+
+    sharding = NamedSharding(mesh, P(axis))
+    return (jax.device_put(jnp.asarray(a, dtype), sharding),
+            jax.device_put(jnp.asarray(xx, dtype), sharding),
+            jax.device_put(jnp.asarray(flags), sharding), n)
+
+
+def _problem_crc(prob: Problem) -> int:
+    """CRC32 over the problem's defining arrays — pins a commit to ITS
+    problem instance so a resume can't silently mix solves."""
+    import zlib
+
+    crc = 0
+    for arr in (prob.a, prob.s, prob.k, prob.x):
+        crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def run_spmv_scan_distributed_supervised(prob: Problem, mesh, ckpt_dir: str,
+                                         every: int = 0, dtype=jnp.float32,
+                                         resume: bool = True,
+                                         heartbeat=None) -> np.ndarray:
+    """Supervised form of the mesh-parallel pipeline: the sharded value
+    vector is epoch-committed (``dist/ckpt.py``) every ``every``
+    iterations with a heartbeat per epoch, and ``resume`` reloads the
+    newest valid commit — **elastically**: the commit stores the true
+    (n,)-length state plus its shard map, so a solve committed on a
+    2-shard mesh resumes on 4 shards (and vice versa), re-padded and
+    re-sharded for the new axis size.  ``faults.maybe_kill_rank`` guards
+    each epoch boundary, mirroring the supervised heat solve.
+
+    Same-mesh resume is bitwise; across shard counts the carry-combine
+    order changes, so results match the single-device reference to the
+    usual scan tolerance instead.
+    """
+    from ..core.faults import maybe_kill_rank
+    from ..dist.ckpt import check_meta, commit_epoch, load_latest_commit
+    from ..dist.scan import make_iterated_sharded_scan
+
+    prob.validate()
+    meta = {"kind": "spmv_scan", "n": prob.n, "iters": prob.iters,
+            "problem_crc": _problem_crc(prob),
+            "dtype": np.dtype(dtype).name}
+    every = every or prob.iters
+    process_id, process_count = 0, 1
+    if jax.process_count() > 1:
+        process_id, process_count = jax.process_index(), jax.process_count()
+
+    start, epoch, values = 0, 0, None
+    loaded = load_latest_commit(ckpt_dir) if resume else None
+    if loaded is not None:
+        manifest, committed = loaded
+        check_meta(manifest, **meta)
+        start, epoch = manifest["step"], manifest["epoch"]
+        values = np.asarray(committed)
+    a_d, xx_d, fl_d, n = _shard_problem(prob, mesh, dtype, values=values)
+    iterate = make_iterated_sharded_scan(mesh)
+    if heartbeat is not None:
+        heartbeat.beat(start)
+    it = start
+    while it < prob.iters:
+        maybe_kill_rank(step=epoch)
+        k = min(every, prob.iters - it)
+        a_d = iterate(a_d, xx_d, fl_d, k)
+        jax.block_until_ready(a_d)
+        it += k
+        epoch += 1
+        commit_epoch(ckpt_dir, epoch, it, a_d, true_shape=(n,), meta=meta,
+                     process_id=process_id, process_count=process_count)
+        if heartbeat is not None:
+            heartbeat.beat(it)
+    return np.asarray(a_d)[:n]
 
 
 # ------------------------------------------------------------------ checking

@@ -1,6 +1,6 @@
 from .timing import PhaseTimer, bandwidth_gbs, gflops
 from .compare import ulp_distance, almost_equal_ulps
-from .errors import check_op, FrameworkError
+from .errors import DataValidationError, check_op, FrameworkError
 from .resilience import (FailureKind, FallbackResult, NonFiniteError,
                          RetryPolicy, all_finite, classify_failure,
                          with_fallback)
@@ -13,6 +13,7 @@ __all__ = [
     "ulp_distance",
     "almost_equal_ulps",
     "check_op",
+    "DataValidationError",
     "FrameworkError",
     "FailureKind",
     "FallbackResult",

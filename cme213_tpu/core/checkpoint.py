@@ -64,16 +64,18 @@ def _payload_crc(step: int, arrays: dict) -> int:
     return crc & 0xFFFFFFFF
 
 
-def save_checkpoint(path: str, step: int, **arrays) -> None:
+def save_checkpoint(path: str, step: int, **arrays) -> int:
     """Atomic write of named arrays + step counter + payload checksum,
     rotating any existing checkpoint to ``<path>.prev`` (last-good
-    retention)."""
+    retention).  Returns the payload CRC32, so callers building commit
+    manifests (``dist/ckpt.py``) can record it without re-reading the
+    file."""
     from .faults import maybe_truncate_file
 
     arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    crc = _payload_crc(step, arrays)
     tmp = path + ".tmp"
-    np.savez(tmp, __step=np.int64(step),
-             __crc=np.uint32(_payload_crc(step, arrays)), **arrays)
+    np.savez(tmp, __step=np.int64(step), __crc=np.uint32(crc), **arrays)
     # np.savez appends .npz to names without an extension
     if not tmp.endswith(".npz") and os.path.exists(tmp + ".npz"):
         tmp = tmp + ".npz"
@@ -81,19 +83,35 @@ def save_checkpoint(path: str, step: int, **arrays) -> None:
     if os.path.exists(path):
         os.replace(path, path + PREV_SUFFIX)
     os.replace(tmp, path)
+    return crc
 
 
-def _read_checkpoint(path: str):
-    """(step, arrays) from one candidate file; raises CheckpointCorrupt (or
-    a zip/npz parse error) on anything invalid."""
+def read_checkpoint(path: str, expect_crc: int | None = None):
+    """(step, arrays, crc) from one candidate file; raises
+    CheckpointCorrupt (or a zip/npz parse error) on anything invalid —
+    no quarantine side effects, so commit-manifest validation
+    (``dist/ckpt.py``) can probe shard files and fall back on its own
+    terms.  ``expect_crc`` additionally pins the payload to a manifest-
+    recorded checksum."""
     with np.load(path, allow_pickle=False) as z:
         if "__step" not in z.files:
             raise CheckpointCorrupt("missing __step (foreign npz?)")
         step = int(z["__step"])
         arrays = {k: z[k] for k in z.files if k not in ("__step", "__crc")}
-        if "__crc" in z.files:  # pre-checksum files stay loadable
-            if int(z["__crc"]) != _payload_crc(step, arrays):
+        crc = int(z["__crc"]) if "__crc" in z.files else None
+        if crc is not None:  # pre-checksum files stay loadable
+            if crc != _payload_crc(step, arrays):
                 raise CheckpointCorrupt("payload checksum mismatch")
+    if expect_crc is not None and crc != expect_crc:
+        raise CheckpointCorrupt(
+            f"payload crc {crc} != manifest-recorded {expect_crc}")
+    return step, arrays, crc
+
+
+def _read_checkpoint(path: str):
+    """(step, arrays) from one candidate file; raises CheckpointCorrupt (or
+    a zip/npz parse error) on anything invalid."""
+    step, arrays, _ = read_checkpoint(path)
     return step, arrays
 
 

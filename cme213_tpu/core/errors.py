@@ -28,6 +28,25 @@ class FrameworkError(RuntimeError):
     record: dict | None = None
 
 
+class DataValidationError(FrameworkError):
+    """External input data failed an invariant check at ingestion (corrupt
+    or truncated matrix file, inconsistent header, out-of-range indices,
+    non-finite values).  Raised *at the boundary* instead of letting the
+    garbage flow downstream into kernels; ``.record`` carries the
+    structured ``data-validation`` trace record (source, invariant,
+    detail)."""
+
+
+def data_error(source: str, invariant: str, detail: str) -> DataValidationError:
+    """Build a DataValidationError with its structured trace record
+    emitted (``data-validation`` event: where, which invariant, what)."""
+    rec = record_event("data-validation", source=source,
+                       invariant=invariant, detail=detail[:300])
+    err = DataValidationError(f"{source}: {invariant}: {detail}")
+    err.record = rec
+    return err
+
+
 def check_op(name: str, *arrays, timer=None):
     """Block until ``arrays`` are ready; re-raise any device error with ``name``.
 

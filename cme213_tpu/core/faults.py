@@ -26,6 +26,13 @@ Spec grammar (comma-separated clauses)::
     ckpt:truncate[:<nth>]         the <nth> checkpoint file written through
                                   ``maybe_truncate_file`` is cut in half
                                   (a torn write / preempted host)
+    ckpt:commit[:<nth>]           the <nth> call of ``maybe_fail_commit``
+                                  raises InjectedFault *before* the COMMIT
+                                  manifest is published — a crash in the
+                                  shard-written-but-uncommitted window of
+                                  the distributed commit protocol
+                                  (``dist/ckpt.py``); first incarnation
+                                  only, so a gang restart recovers
     rankkill:<rank>[:<step>]      ``maybe_kill_rank()`` hard-exits with
                                   ``KILL_EXIT`` on guarded step <step>
                                   (0-based, default 0) when
@@ -106,11 +113,11 @@ class FaultPlan:
                         kind, parts[1],
                         nth=int(parts[2]) if len(parts) > 2 else 1))
                 elif kind == "ckpt":
-                    if parts[1] != "truncate":
+                    if parts[1] not in ("truncate", "commit"):
                         raise FaultSpecError(
                             f"unknown ckpt fault {parts[1]!r}")
                     clauses.append(_Clause(
-                        kind, "truncate",
+                        kind, parts[1],
                         nth=int(parts[2]) if len(parts) > 2 else 1))
                 else:  # rankkill
                     clauses.append(_Clause(
@@ -220,6 +227,22 @@ def maybe_truncate_file(path: str) -> bool:
         f.truncate(size // 2)
     _record("ckpt-truncate", path, bytes=size // 2)
     return True
+
+
+def maybe_fail_commit() -> None:
+    """Raise InjectedFault before a distributed COMMIT publish if a
+    ``ckpt:commit`` clause fires — the shard-files-written-but-manifest-
+    unpublished crash window of ``dist/ckpt.py``.  Like ``rankkill``,
+    gated to the first incarnation so a supervised gang restart recovers
+    deterministically instead of re-crashing forever."""
+    plan = active()
+    if plan is None:
+        return
+    for c in plan._matching("ckpt", "commit"):
+        if c.fires() and incarnation() == 0:
+            _record("ckpt-commit-abort", "commit", call=c.calls)
+            raise InjectedFault(
+                f"injected crash before COMMIT publish (call {c.calls})")
 
 
 def incarnation() -> int:

@@ -1,7 +1,7 @@
 from .mesh import make_mesh_1d, make_mesh_2d, mesh_for_method
 from .heat import (distributed_heat_step, prepare_distributed_heat,
-                   run_distributed_heat)
-from .scan import distributed_segmented_scan
+                   run_distributed_heat, run_distributed_heat_supervised)
+from .scan import distributed_segmented_scan, make_iterated_sharded_scan
 
 __all__ = [
     "make_mesh_1d",
@@ -10,5 +10,7 @@ __all__ = [
     "distributed_heat_step",
     "prepare_distributed_heat",
     "run_distributed_heat",
+    "run_distributed_heat_supervised",
     "distributed_segmented_scan",
+    "make_iterated_sharded_scan",
 ]

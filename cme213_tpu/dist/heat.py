@@ -329,6 +329,129 @@ def prepare_distributed_heat(params: SimParams, mesh: Mesh,
     return iterate, overlap, k
 
 
+def _mesh_layout(params: SimParams, mesh: Mesh):
+    """(y_size, x_size, ny_loc, nx_loc, spec) for ``params`` on ``mesh``,
+    with the same local-extent validation as ``prepare_distributed_heat``
+    (ghost padding supports non-divisible grids)."""
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    y_size = axes.get("y", 1)
+    x_size = axes.get("x", 1)
+    b = params.border_size
+    ny_loc = -(-params.ny // y_size)
+    nx_loc = -(-params.nx // x_size)
+    if ny_loc < b or nx_loc < b:
+        raise ValueError(
+            f"local block ({ny_loc}×{nx_loc}) thinner than the stencil "
+            f"border ({b}); use fewer devices or a larger grid")
+    return y_size, x_size, ny_loc, nx_loc, P("y", "x" if "x" in axes else None)
+
+
+def _pad_interior_for_mesh(u: np.ndarray, params: SimParams,
+                           y_size: int, x_size: int) -> np.ndarray:
+    """Ghost-pad a true (ny, nx) interior so it divides over the mesh —
+    held at the top/right BC values each step (the reference's remainder-
+    rank layout expressed as padding)."""
+    ny_pad = -(-params.ny // y_size) * y_size
+    nx_pad = -(-params.nx // x_size) * x_size
+    if ny_pad > params.ny:
+        pad_rows = np.full((ny_pad - params.ny, u.shape[1]), params.bc_top,
+                           u.dtype)
+        u = np.concatenate([u, pad_rows], axis=0)
+    if nx_pad > params.nx:
+        pad_cols = np.full((u.shape[0], nx_pad - params.nx), params.bc_right,
+                           u.dtype)
+        u = np.concatenate([u, pad_cols], axis=1)
+    return u
+
+
+def run_distributed_heat_supervised(params: SimParams, mesh: Mesh,
+                                    ckpt_dir: str, ckpt_every: int = 0,
+                                    iters: int | None = None,
+                                    dtype=jnp.float32,
+                                    overlap: bool | None = None,
+                                    resume: bool = True,
+                                    heartbeat=None,
+                                    commit_timeout: float = 120.0
+                                    ) -> np.ndarray:
+    """The supervised form of ``run_distributed_heat``: the solve runs in
+    epochs of ``ckpt_every`` iterations, each ending in an epoch-committed
+    distributed checkpoint (``dist/ckpt.py``) and a heartbeat carrying the
+    step counter (``dist/supervisor.py``) — the two hooks gang supervision
+    needs to detect a dead or frozen rank and relaunch the whole gang from
+    the last globally consistent state.
+
+    ``resume`` loads the newest valid commit in ``ckpt_dir`` (this is how
+    a gang restart continues; ``CME213_RESUME`` gates it from the
+    launcher).  Resume is **elastic**: the commit records the shard map,
+    so the global grid is reassembled and re-decomposed for *this* mesh
+    even when the committed run used a different device count or
+    ``GridMethod`` — and on the sync path every decomposition is bitwise-
+    identical per cell, so the recovered solve equals an uninterrupted one
+    exactly.  ``faults.maybe_kill_rank`` guards each epoch boundary so
+    ``CME213_FAULTS=rankkill:<rank>:<epoch>`` injects a deterministic
+    mid-solve death for recovery tests.
+
+    Returns the final full halo grid (gy, gx) as numpy, like
+    ``run_distributed_heat``.
+    """
+    from ..core.faults import maybe_kill_rank
+    from .ckpt import check_meta, commit_epoch, load_latest_commit
+
+    iters = params.iters if iters is None else iters
+    ckpt_every = ckpt_every or iters
+    overlap = (not params.synchronous) if overlap is None else overlap
+    y_size, x_size, ny_loc, nx_loc, spec = _mesh_layout(params, mesh)
+    b = params.border_size
+    if overlap and (ny_loc < 2 * b or nx_loc < 2 * b):
+        overlap = False
+    meta = {"kind": "heat2d", "ny": params.ny, "nx": params.nx,
+            "order": params.order, "border": b,
+            "grid_method": int(params.grid_method),
+            "dtype": np.dtype(dtype).name}
+    process_id, process_count = 0, 1
+    if jax.process_count() > 1:  # real multi-process gang
+        process_id, process_count = jax.process_index(), jax.process_count()
+
+    start, epoch = 0, 0
+    loaded = load_latest_commit(ckpt_dir) if resume else None
+    if loaded is not None:
+        manifest, interior_grid = loaded
+        check_meta(manifest, **meta)
+        start, epoch = manifest["step"], manifest["epoch"]
+        u_host = _pad_interior_for_mesh(
+            np.asarray(interior_grid, dtype=np.dtype(dtype)),
+            params, y_size, x_size)
+    else:
+        full0 = make_initial_grid(params, dtype=dtype)
+        u_host = _pad_interior_for_mesh(np.array(interior(full0, b)),
+                                        params, y_size, x_size)
+
+    sharding = NamedSharding(mesh, spec)
+    u = jax.device_put(jnp.asarray(u_host, dtype), sharding)
+    if heartbeat is not None:
+        heartbeat.beat(start)
+    it = start
+    while it < iters:
+        # deterministic kill window: `step` counts committed epochs, so
+        # rankkill:<rank>:<e> always dies holding exactly e commits
+        maybe_kill_rank(step=epoch)
+        k = min(ckpt_every, iters - it)
+        u = _run(u, params, mesh, k, overlap)
+        jax.block_until_ready(u)
+        it += k
+        epoch += 1
+        commit_epoch(ckpt_dir, epoch, it, u,
+                     true_shape=(params.ny, params.nx), meta=meta,
+                     process_id=process_id, process_count=process_count,
+                     timeout=commit_timeout)
+        if heartbeat is not None:
+            heartbeat.beat(it)
+    out = np.asarray(u)
+    final = np.array(make_initial_grid(params, dtype=dtype))
+    final[b:-b, b:-b] = out[:params.ny, :params.nx]
+    return final
+
+
 def run_distributed_heat(params: SimParams, mesh: Mesh,
                          iters: int | None = None, dtype=jnp.float32,
                          overlap: bool | None = None,

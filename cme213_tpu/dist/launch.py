@@ -35,6 +35,22 @@ Unlike the reference's MPI_Abort-only model, failure handling is layered:
 Only a rank exhausting its restart budget fails the job (fail-fast: the
 remaining ranks are then terminated, the MPI_Abort analog).
 
+**Supervised gangs** (``--stall-timeout``, ``launch_supervised``): the
+per-rank restart above cannot help a rank that dies *mid-collective* — its
+peers stay blocked in the halo exchange forever, and only the blunt
+whole-job ``--timeout`` ends the misery.  Supervised mode instead treats
+the gang as the failure unit (TorchElastic-style): ranks emit file-based
+heartbeats carrying their step counter (``dist/supervisor.py``), and the
+launcher distinguishes "rank exited" (poll) from "rank alive but frozen"
+(heartbeat step unchanged for ``--stall-timeout`` seconds — the hung
+collective).  Either verdict kills the WHOLE gang and relaunches it — on a
+fresh coordinator port, with the gang incarnation bumped — and the
+workload resumes from the last committed epoch (``dist/ckpt.py``, wired by
+``--ckpt-dir``/``--ckpt-every``/``--resume``).  Recovery from an injected
+``CME213_FAULTS=rankkill:...`` is deterministic: the fault fires only in
+incarnation 0, and epoch-committed checkpoints make the recovered solve
+bitwise-equal to an uninterrupted sync-path run.
+
 On a real multi-host TPU pod each host runs its own process via the cluster
 scheduler and ``--np``/``--proc-id`` come from it; this launcher covers the
 reference's single-node ``nodes=1:ppn=N`` placement axis and CI, where
@@ -48,6 +64,7 @@ import os
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -146,6 +163,147 @@ def launch(np_procs: int, cmd: list[str], devices_per_proc: int | None = None,
     return rc
 
 
+def launch_supervised(np_procs: int, cmd: list[str],
+                      devices_per_proc: int | None = None,
+                      timeout: float | None = None,
+                      handshake_timeout: float | None = None,
+                      max_restarts: int = 1,
+                      heartbeat_interval: float = 1.0,
+                      stall_timeout: float = 30.0,
+                      ckpt_dir: str | None = None, ckpt_every: int = 0,
+                      resume: bool = False,
+                      poll_interval: float = 0.05) -> int:
+    """Run ``cmd`` as a supervised gang of ``np_procs`` ranks.
+
+    Failure unit = the gang: a rank exiting nonzero OR a rank whose
+    heartbeat step freezes for ``stall_timeout`` seconds (hung collective)
+    condemns the incarnation — every rank is killed and the gang is
+    relaunched on a fresh coordinator port with ``CME213_INCARNATION``
+    bumped, up to ``max_restarts`` times.  Relaunched incarnations always
+    get ``CME213_RESUME=1`` so the workload resumes from the last
+    committed epoch; the first incarnation resumes only when ``resume``.
+
+    Returns 0 on success, the condemning rank's exit code once the budget
+    is exhausted (124 for a stall — it is a hang, and the capture layer
+    already classifies 124 that way), or 124 on whole-job ``timeout``.
+    """
+    from ..core.trace import record_event
+    from .supervisor import (CKPT_DIR_ENV, CKPT_EVERY_ENV, GangSupervisor,
+                             HEARTBEAT_DIR_ENV, HEARTBEAT_INTERVAL_ENV,
+                             RESUME_ENV)
+
+    if ckpt_dir:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        hb_dir = os.path.join(ckpt_dir, ".heartbeats")
+    else:
+        hb_dir = tempfile.mkdtemp(prefix="cme213_hb_")
+    supervisor = GangSupervisor(hb_dir, np_procs, stall_timeout)
+    pumps = []
+
+    def spawn_gang(incarnation: int) -> dict[int, subprocess.Popen]:
+        # fresh coordinator port per incarnation: the previous port may be
+        # lingering in TIME_WAIT or held by a not-yet-reaped rank
+        coordinator = f"127.0.0.1:{free_port()}"
+        procs = {}
+        for rank in range(np_procs):
+            env = dict(os.environ,
+                       JAX_COORDINATOR_ADDRESS=coordinator,
+                       JAX_NUM_PROCESSES=str(np_procs),
+                       JAX_PROCESS_ID=str(rank),
+                       CME213_INCARNATION=str(incarnation))
+            env[HEARTBEAT_DIR_ENV] = hb_dir
+            env[HEARTBEAT_INTERVAL_ENV] = str(heartbeat_interval)
+            if ckpt_dir:
+                env[CKPT_DIR_ENV] = ckpt_dir
+                env[CKPT_EVERY_ENV] = str(ckpt_every)
+            env[RESUME_ENV] = "1" if (resume or incarnation > 0) else "0"
+            if handshake_timeout is not None:
+                env["CME213_HANDSHAKE_TIMEOUT"] = str(handshake_timeout)
+            if devices_per_proc:
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count="
+                      f"{devices_per_proc}").strip()
+                env["JAX_PLATFORMS"] = "cpu"
+            p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True)
+            t = threading.Thread(target=_pump,
+                                 args=(rank, p.stdout, sys.stdout),
+                                 daemon=True)
+            t.start()
+            pumps.append(t)
+            procs[rank] = p
+        return procs
+
+    def kill_gang(procs) -> None:
+        for q in procs.values():
+            if q.poll() is None:
+                q.terminate()
+        deadline = time.monotonic() + 5
+        for q in procs.values():
+            while q.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+            if q.poll() is None:
+                q.kill()
+                q.wait()
+
+    deadline = (time.monotonic() + timeout) if timeout else None
+    incarnation = 0
+    procs = spawn_gang(0)
+    rc = 0
+    try:
+        while True:
+            condemned = None  # {"rank", "reason", ...} of the first verdict
+            exited = {r: p.poll() for r, p in procs.items()}
+            for rank, code in sorted(exited.items()):
+                if code is not None and code != 0:
+                    condemned = {"rank": rank, "reason": "exit",
+                                 "code": code}
+                    break
+            if condemned is None and all(c == 0 for c in exited.values()):
+                return 0
+            if condemned is None:
+                for s in supervisor.stalled():
+                    if exited[s["rank"]] is None:  # alive but frozen
+                        condemned = {**s, "reason": "stall"}
+                        break
+            if condemned is None:
+                if deadline is not None and time.monotonic() > deadline:
+                    print(f"[launcher] timeout after {timeout}s; killing "
+                          f"the gang", flush=True)
+                    return 124
+                time.sleep(poll_interval)
+                continue
+
+            rc = condemned.get("code", 124)  # stall = hang = 124
+            record_event("rank-failed", **condemned,
+                         incarnation=incarnation)
+            print(f"[launcher] rank {condemned['rank']} "
+                  + (f"exited {condemned['code']}"
+                     if condemned["reason"] == "exit"
+                     else f"stalled at step {condemned.get('step')} for "
+                          f"{condemned.get('stalled_s')}s")
+                  + "; condemning the gang", flush=True)
+            kill_gang(procs)
+            if incarnation >= max_restarts:
+                print(f"[launcher] gang restart budget exhausted "
+                      f"({max_restarts}); failing", flush=True)
+                return rc
+            incarnation += 1
+            record_event("gang-restart", incarnation=incarnation,
+                         reason=condemned["reason"],
+                         rank=condemned["rank"])
+            print(f"[launcher] gang restart "
+                  f"(incarnation {incarnation}/{max_restarts}), resuming "
+                  f"from last committed epoch", flush=True)
+            supervisor.reset()
+            procs = spawn_gang(incarnation)
+    finally:
+        kill_gang(procs)
+        for t in pumps:
+            t.join(timeout=5)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="mpirun-style launcher for multi-process JAX runs")
@@ -164,13 +322,42 @@ def main(argv=None) -> int:
                          "exported to ranks as CME213_HANDSHAKE_TIMEOUT")
     ap.add_argument("--max-restarts", type=int, default=0,
                     help="relaunch a failed rank (same rank id) up to this "
-                         "many times before failing the job")
+                         "many times before failing the job; in supervised "
+                         "mode (--stall-timeout) this is the GANG restart "
+                         "budget")
+    ap.add_argument("--stall-timeout", type=float, default=None,
+                    help="supervised mode: condemn the gang when any live "
+                         "rank's heartbeat step is frozen this many "
+                         "seconds (hung collective); the gang is killed "
+                         "and relaunched from the last committed epoch")
+    ap.add_argument("--heartbeat-interval", type=float, default=1.0,
+                    help="supervised mode: seconds between same-step "
+                         "heartbeat re-emits (exported as "
+                         "CME213_HEARTBEAT_INTERVAL)")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="supervised mode: epoch-commit checkpoint "
+                         "directory (exported as CME213_CKPT_DIR)")
+    ap.add_argument("--ckpt-every", type=int, default=0,
+                    help="supervised mode: iterations per committed epoch "
+                         "(exported as CME213_CKPT_EVERY)")
+    ap.add_argument("--resume", action="store_true",
+                    help="supervised mode: the FIRST incarnation also "
+                         "resumes from an existing commit in --ckpt-dir "
+                         "(gang restarts always resume)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="command to launch (prefix with --)")
     args = ap.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
     if not cmd:
         ap.error("no command given (append: -- python your_script.py)")
+    if args.stall_timeout is not None:
+        return launch_supervised(
+            args.np_procs, cmd, args.devices_per_proc,
+            timeout=args.timeout, handshake_timeout=args.handshake_timeout,
+            max_restarts=args.max_restarts,
+            heartbeat_interval=args.heartbeat_interval,
+            stall_timeout=args.stall_timeout, ckpt_dir=args.ckpt_dir,
+            ckpt_every=args.ckpt_every, resume=args.resume)
     return launch(args.np_procs, cmd, args.devices_per_proc,
                   args.coordinator, timeout=args.timeout,
                   handshake_timeout=args.handshake_timeout,

@@ -18,6 +18,22 @@ from __future__ import annotations
 
 import os
 
+#: the exact error this jaxlib's CPU backend raises when a cross-process
+#: collective is attempted — a missing *capability*, not a bug in the
+#: workload.  Tests and CI smokes probe worker output for it and turn the
+#: run into an explicit skip; any OTHER worker error stays a hard failure.
+MULTIPROCESS_UNSUPPORTED_MSG = (
+    "Multiprocess computations aren't implemented on the CPU backend")
+
+
+def multiprocess_unsupported(output: str) -> bool:
+    """Capability probe over captured worker output: True iff the failure
+    is this backend's known can't-do-multiprocess error (skip-worthy),
+    False for everything else (hard-fail-worthy).  Shared by
+    ``tests/test_multihost.py`` and ``scripts/faultcheck.sh`` so the skip
+    criterion lives in exactly one place."""
+    return MULTIPROCESS_UNSUPPORTED_MSG in output
+
 
 def initialize_multihost(coordinator_address: str | None = None,
                          num_processes: int | None = None,

@@ -88,6 +88,41 @@ def _local_with_carry(values, flags, axis_name: str, axis_size: int,
     return local + jnp.where(no_head_yet, incoming, jnp.zeros_like(incoming))
 
 
+def make_iterated_sharded_scan(mesh: Mesh, axis_name: str | None = None,
+                               carry_mode: str = "ring"):
+    """Build the device-resident iterated form of the sharded scan — the
+    ``a ← segmented_scan(a · xx)`` hot loop of ``apps/spmv_scan`` run as N
+    iterations inside ONE ``shard_map``-of-``jit`` (no resharding between
+    iterations, input buffer donated).
+
+    Returns ``iterate(a, xx, flags, iters)``; all three arrays must
+    already be sharded over ``axis_name``.  This is the chunk runner the
+    supervised/checkpointed distributed solve drives epoch by epoch: the
+    same jitted callable serves every chunk length from one cache entry
+    per distinct ``iters``.
+    """
+    axis_name = axis_name or mesh.axis_names[0]
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    spec = P(axis_name)
+
+    @partial(jax.jit, static_argnames=("iters",), donate_argnums=(0,))
+    def iterate(a_d, xx_d, fl_d, iters: int):
+        def sharded(a_blk, xx_blk, fl_blk):
+            def body(_, v):
+                return _local_with_carry(v * xx_blk, fl_blk,
+                                         axis_name=axis_name,
+                                         axis_size=axis_size,
+                                         carry_mode=carry_mode)
+
+            return jax.lax.fori_loop(0, iters, body, a_blk)
+
+        return shard_map(sharded, mesh=mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec)(a_d, xx_d, fl_d)
+
+    return iterate
+
+
 def distributed_segmented_scan(values: jnp.ndarray, head_flags: jnp.ndarray,
                                mesh: Mesh, axis_name: str | None = None,
                                carry_mode: str = "ring"):

@@ -46,6 +46,17 @@ promote_bench() {  # $1 = final json path (expects $1.new from the run)
   # replace a file holding live-measured rows
   new_ok=$(count_measured_rows "$1.new")
   old_ok=$(count_measured_rows "$1")
+  # zero-zero tie guard: with no measured rows on either side, only a
+  # structurally sane .new (it at least reached the report stage and
+  # carries the kernels-table unit field) may replace the incumbent — an
+  # early bench.py crash must not promote an empty/garbage file over a
+  # previous structured DEVICE-UNAVAILABLE record
+  if [ "$new_ok" -eq 0 ] && [ "$old_ok" -eq 0 ] \
+     && ! grep -q '"unit": "GB/s"' "$1.new"; then
+    echo "discarding $1.new (no measured rows and no structured report)"
+    rm -f "$1.new"
+    return
+  fi
   if [ "$new_ok" -ge "$old_ok" ]; then
     mv "$1.new" "$1"   # at least as many measured rows (fresher wins ties)
   else

@@ -1,0 +1,246 @@
+"""Epoch-committed distributed checkpoints: the commit protocol's crash
+windows, elastic resume across rank counts and decompositions, and the
+supervised solvers' bitwise-recovery contract — all single-process on the
+CPU fake mesh (the backend here has no multiprocess collectives; the
+protocol is file-based precisely so these invariants are pinnable without
+a pod).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cme213_tpu.config import GridMethod, SimParams
+from cme213_tpu.core import faults, trace
+from cme213_tpu.dist import (make_mesh_1d, make_mesh_2d, run_distributed_heat,
+                             run_distributed_heat_supervised)
+from cme213_tpu.dist.ckpt import (CommitError, check_meta, load_latest_commit)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    trace.clear_events()
+    yield
+    faults.reset()
+
+
+P_SMALL = SimParams(nx=32, ny=32, order=4, iters=8)
+
+
+def _ckpt(tmp_path, name="ckpt"):
+    return str(tmp_path / name)
+
+
+# ------------------------------------------------------- commit + resume
+
+def test_supervised_equals_uninterrupted_bitwise(tmp_path):
+    mesh = make_mesh_1d(2)
+    ref = run_distributed_heat(P_SMALL, mesh)
+    out = run_distributed_heat_supervised(P_SMALL, mesh, _ckpt(tmp_path),
+                                          ckpt_every=2)
+    np.testing.assert_array_equal(out, ref)
+    commits = trace.events("epoch-commit")
+    assert [c["epoch"] for c in commits] == [1, 2, 3, 4]
+    assert commits[-1]["step"] == 8
+
+
+def test_resume_continues_from_commit_bitwise(tmp_path):
+    """Stop after 4 of 8 iters (a committed mid-solve state), then resume
+    the full solve: the recovered run is bitwise-equal to uninterrupted —
+    deterministic chunking on the sync path."""
+    mesh = make_mesh_1d(2)
+    d = _ckpt(tmp_path)
+    run_distributed_heat_supervised(P_SMALL, mesh, d, ckpt_every=2, iters=4)
+    out = run_distributed_heat_supervised(P_SMALL, mesh, d, ckpt_every=2)
+    np.testing.assert_array_equal(out, run_distributed_heat(P_SMALL, mesh))
+    # the resumed leg only commits epochs 3 and 4
+    assert [c["epoch"] for c in trace.events("epoch-commit")] == [1, 2, 3, 4]
+
+
+def test_resume_off_ignores_existing_commit(tmp_path):
+    mesh = make_mesh_1d(2)
+    d = _ckpt(tmp_path)
+    run_distributed_heat_supervised(P_SMALL, mesh, d, ckpt_every=2, iters=4)
+    out = run_distributed_heat_supervised(P_SMALL, mesh, d, ckpt_every=4,
+                                          resume=False)
+    np.testing.assert_array_equal(out, run_distributed_heat(P_SMALL, mesh))
+
+
+def test_retention_keeps_two_generations(tmp_path):
+    mesh = make_mesh_1d(2)
+    d = _ckpt(tmp_path)
+    run_distributed_heat_supervised(P_SMALL, mesh, d, ckpt_every=2)
+    names = sorted(n for n in os.listdir(d) if n.startswith("epoch_"))
+    assert names == ["epoch_00000003", "epoch_00000004"]  # older GC'd
+    assert json.load(open(os.path.join(d, "COMMIT")))["epoch"] == 4
+    assert json.load(open(os.path.join(d, "COMMIT.prev")))["epoch"] == 3
+
+
+# ------------------------------------------------------- crash windows
+
+def test_crash_between_shards_and_commit_resumes_prior_epoch(tmp_path):
+    """The window the protocol exists for: epoch-2 shards are durable but
+    the COMMIT publish never happened — resume must land on epoch 1,
+    never the torn epoch 2."""
+    mesh = make_mesh_1d(2)
+    d = _ckpt(tmp_path)
+    with faults.injected("ckpt:commit:2"):
+        with pytest.raises(faults.InjectedFault):
+            run_distributed_heat_supervised(P_SMALL, mesh, d, ckpt_every=2)
+    manifest, _ = load_latest_commit(d)
+    assert (manifest["epoch"], manifest["step"]) == (1, 2)
+    # recovery recomputes the lost epoch; final grid is bitwise-clean
+    out = run_distributed_heat_supervised(P_SMALL, mesh, d, ckpt_every=2)
+    np.testing.assert_array_equal(out, run_distributed_heat(P_SMALL, mesh))
+
+
+def test_torn_manifest_falls_back_a_generation(tmp_path):
+    """ckpt:truncate tearing the COMMIT file itself (epoch 2's publish:
+    write #3 after two epoch-1 shards... each epoch writes 2 shards +
+    1 manifest, so the 6th checkpoint-file write is epoch 2's manifest):
+    the torn COMMIT is skipped and COMMIT.prev (epoch 1) serves."""
+    mesh = make_mesh_1d(2)
+    d = _ckpt(tmp_path)
+    with faults.injected("ckpt:truncate:6"):
+        run_distributed_heat_supervised(P_SMALL, mesh, d, ckpt_every=2,
+                                        iters=4)
+    manifest, _ = load_latest_commit(d)
+    assert (manifest["epoch"], manifest["step"]) == (1, 2)
+    assert any(e["candidate"] == "COMMIT"
+               for e in trace.events("commit-invalid"))
+
+
+def test_torn_shard_write_aborts_commit_not_resume(tmp_path):
+    """A shard torn at write time (ckpt:truncate on an epoch-2 shard) is
+    caught by the pre-publish read-back validation — the commit aborts
+    with the previous epoch intact, instead of publishing a manifest over
+    a bad shard and failing at resume time."""
+    mesh = make_mesh_1d(2)
+    d = _ckpt(tmp_path)
+    with faults.injected("ckpt:truncate:4"):  # 2nd shard of epoch 2
+        with pytest.raises(CommitError):
+            run_distributed_heat_supervised(P_SMALL, mesh, d, ckpt_every=2)
+    manifest, _ = load_latest_commit(d)
+    assert (manifest["epoch"], manifest["step"]) == (1, 2)
+
+
+def test_shard_corrupted_after_publish_falls_back(tmp_path):
+    """Bit-rot under a published commit: resume detects the checksum
+    mismatch and falls back to the previous committed epoch."""
+    mesh = make_mesh_1d(2)
+    d = _ckpt(tmp_path)
+    run_distributed_heat_supervised(P_SMALL, mesh, d, ckpt_every=2, iters=4)
+    live = json.load(open(os.path.join(d, "COMMIT")))
+    shard = os.path.join(d, live["epoch_dir"], live["shards"][0]["file"])
+    size = os.path.getsize(shard)
+    with open(shard, "r+b") as f:
+        f.truncate(size // 2)
+    manifest, _ = load_latest_commit(d)
+    assert manifest["epoch"] == live["epoch"] - 1
+    assert trace.events("commit-invalid")
+
+
+def test_nothing_recoverable_returns_none(tmp_path):
+    assert load_latest_commit(str(tmp_path)) is None
+
+
+# ------------------------------------------------------- elastic resume
+
+@pytest.mark.parametrize("mesh_a, mesh_b", [
+    (lambda: make_mesh_1d(2), lambda: make_mesh_1d(4)),   # 2 -> 4 ranks
+    (lambda: make_mesh_1d(4), lambda: make_mesh_1d(2)),   # 4 -> 2 ranks
+    (lambda: make_mesh_1d(4), lambda: make_mesh_2d(2, 2)),  # stripes->blocks
+    (lambda: make_mesh_2d(2, 2), lambda: make_mesh_1d(2)),  # blocks->stripes
+])
+def test_elastic_resume_bitwise(tmp_path, mesh_a, mesh_b):
+    """A commit written under one decomposition resumes under another —
+    different rank count, even a different GridMethod — and the final
+    grid still bitwise-matches the single-decomposition reference (the
+    sync path is arithmetically identical per cell on every mesh)."""
+    d = _ckpt(tmp_path)
+    run_distributed_heat_supervised(P_SMALL, mesh_a(), d, ckpt_every=2,
+                                    iters=4)
+    out = run_distributed_heat_supervised(P_SMALL, mesh_b(), d, ckpt_every=2)
+    np.testing.assert_array_equal(
+        out, run_distributed_heat(P_SMALL, make_mesh_1d(2)))
+
+
+def test_elastic_resume_nondivisible_grid(tmp_path):
+    """Ghost padding differs per mesh (30 rows over 4 devices pads to 32;
+    over 2 it doesn't pad at all) — the commit stores the TRUE interior,
+    so re-decomposition re-derives the padding."""
+    p = SimParams(nx=30, ny=30, order=2, iters=6)
+    d = _ckpt(tmp_path)
+    run_distributed_heat_supervised(p, make_mesh_1d(4), d, ckpt_every=2,
+                                    iters=2)
+    out = run_distributed_heat_supervised(p, make_mesh_1d(2), d, ckpt_every=2)
+    np.testing.assert_array_equal(out, run_distributed_heat(p, make_mesh_1d(2)))
+
+
+def test_meta_mismatch_refuses_resume(tmp_path):
+    d = _ckpt(tmp_path)
+    run_distributed_heat_supervised(P_SMALL, make_mesh_1d(2), d,
+                                    ckpt_every=2, iters=2)
+    other = SimParams(nx=32, ny=32, order=2, iters=8)  # different order
+    with pytest.raises(CommitError):
+        run_distributed_heat_supervised(other, make_mesh_1d(2), d,
+                                        ckpt_every=2)
+
+
+def test_check_meta_reports_mismatched_keys(tmp_path):
+    d = _ckpt(tmp_path)
+    run_distributed_heat_supervised(P_SMALL, make_mesh_1d(2), d,
+                                    ckpt_every=2, iters=2)
+    manifest, _ = load_latest_commit(d)
+    check_meta(manifest, ny=32, order=4)  # matching subset passes
+    with pytest.raises(CommitError, match="order"):
+        check_meta(manifest, ny=32, order=8)
+
+
+# ------------------------------------------------------- sharded scan
+
+def test_supervised_scan_matches_plain_and_single_device(tmp_path):
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(512, 16, 15, iters=6, seed=0)
+    mesh = make_mesh_1d(2)
+    ref_dist = sp.run_spmv_scan_distributed(prob, mesh)
+    out = sp.run_spmv_scan_distributed_supervised(prob, mesh,
+                                                  _ckpt(tmp_path), every=2)
+    np.testing.assert_array_equal(out, ref_dist)  # same mesh: bitwise
+    np.testing.assert_allclose(out, sp.run_spmv_scan(prob), rtol=1e-5)
+
+
+def test_supervised_scan_elastic_crash_resume(tmp_path):
+    """Crash the scan solve in the commit window on 2 shards, resume on 4:
+    the elastic path must still match the single-device reference."""
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(512, 16, 15, iters=6, seed=1)
+    d = _ckpt(tmp_path)
+    with faults.injected("ckpt:commit:2"):
+        with pytest.raises(faults.InjectedFault):
+            sp.run_spmv_scan_distributed_supervised(prob, make_mesh_1d(2),
+                                                    d, every=2)
+    manifest, _ = load_latest_commit(d)
+    assert manifest["step"] == 2  # prior epoch survived the torn commit
+    out = sp.run_spmv_scan_distributed_supervised(prob, make_mesh_1d(4),
+                                                  d, every=2)
+    np.testing.assert_allclose(out, sp.run_spmv_scan(prob), rtol=1e-5)
+
+
+def test_supervised_scan_refuses_foreign_problem(tmp_path):
+    """The commit pins a CRC of the problem's defining arrays — resuming a
+    DIFFERENT problem from it must refuse, not silently mix solves."""
+    from cme213_tpu.apps import spmv_scan as sp
+
+    d = _ckpt(tmp_path)
+    prob = sp.generate_problem(512, 16, 15, iters=6, seed=2)
+    sp.run_spmv_scan_distributed_supervised(prob, make_mesh_1d(2), d,
+                                            every=2)
+    other = sp.generate_problem(512, 16, 15, iters=6, seed=3)
+    with pytest.raises(CommitError):
+        sp.run_spmv_scan_distributed_supervised(other, make_mesh_1d(2), d,
+                                                every=2)

@@ -1,0 +1,184 @@
+"""MatrixMarket ingestion hardening: every malformed input is rejected at
+the boundary with a structured ``DataValidationError`` (plus a
+``data-validation`` trace event) instead of flowing downstream as garbage —
+where a bad column index would surface as a silent gather clamp and a
+truncated file as a wrong-but-finite answer.
+
+The corruption matrix is property-style: start from one known-good file
+and apply independent, realistic damage (truncation at several byte
+offsets, header lies, out-of-range indices, non-finite values, fractional
+indices) — each must either parse to the SAME arrays as the pristine file
+or raise the structured error, never a third thing.
+"""
+
+import numpy as np
+import pytest
+
+from cme213_tpu.core import DataValidationError, trace
+from cme213_tpu.apps.matrix_market import (coo_to_csr, csr_from_mtx,
+                                           read_matrix_market, validate_csr)
+
+GOOD = (
+    "%%MatrixMarket matrix coordinate real general\n"
+    "% a comment\n"
+    "3 4 5\n"
+    "1 1 2.0\n"
+    "2 2 3.0\n"
+    "3 1 -1.0\n"
+    "3 3 4.0\n"
+    "1 4 0.5\n"
+)
+
+
+def _write(tmp_path, text, name="m.mtx"):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_good_file_parses_and_csr_validates(tmp_path):
+    indptr, indices, data, shape = csr_from_mtx(_write(tmp_path, GOOD))
+    assert shape == (3, 4)
+    np.testing.assert_array_equal(indptr, [0, 2, 3, 5])
+    np.testing.assert_array_equal(indices, [0, 3, 1, 0, 2])
+    # canonical: columns sorted within each row
+    np.testing.assert_array_equal(data, [2.0, 0.5, 3.0, -1.0, 4.0])
+
+
+@pytest.mark.parametrize("mutation, invariant", [
+    ("not a matrix at all\n1 2 3\n", "banner"),
+    ("%%MatrixMarket matrix coordinate\n1 1 1\n1 1 1.0\n", "banner"),
+    ("%%MatrixMarket matrix array real general\n2 2\n1.0\n", "format"),
+    ("%%MatrixMarket matrix coordinate complex general\n1 1 1\n"
+     "1 1 1.0 0.0\n", "field"),
+    ("%%MatrixMarket matrix coordinate real skew-symmetric\n1 1 1\n"
+     "1 1 1.0\n", "symmetry"),
+    ("%%MatrixMarket matrix coordinate real general\nthree three 4\n",
+     "size-line"),
+    ("%%MatrixMarket matrix coordinate real general\n0 3 1\n1 1 1.0\n",
+     "size-line"),
+])
+def test_header_lies_raise_structured(tmp_path, mutation, invariant):
+    trace.clear_events()
+    with pytest.raises(DataValidationError) as ei:
+        read_matrix_market(_write(tmp_path, mutation))
+    assert ei.value.record["invariant"] == invariant
+    assert trace.events("data-validation")
+
+
+@pytest.mark.parametrize("bad_entry, invariant", [
+    ("4 1 1.0", "index-bounds"),       # row beyond nr=3
+    ("1 5 1.0", "index-bounds"),       # col beyond nc=4
+    ("0 1 1.0", "index-bounds"),       # below the 1-based origin
+    ("1.5 1 1.0", "index-integrality"),
+    ("1 1 nan", "value-finiteness"),
+    ("1 1 inf", "value-finiteness"),
+])
+def test_bad_entries_raise_structured(tmp_path, bad_entry, invariant):
+    text = GOOD.replace("1 4 0.5", bad_entry)
+    with pytest.raises(DataValidationError) as ei:
+        read_matrix_market(_write(tmp_path, text))
+    assert ei.value.record["invariant"] == invariant
+
+
+def test_truncation_at_every_entry_boundary(tmp_path):
+    """A download cut at ANY entry boundary (fewer data lines than the
+    header's nnz) is a structured entry-count error, never a silent
+    short parse."""
+    lines = GOOD.strip().split("\n")
+    for keep in range(3, len(lines)):  # header + size kept, entries cut
+        text = "\n".join(lines[:keep]) + "\n"
+        with pytest.raises(DataValidationError) as ei:
+            read_matrix_market(_write(tmp_path, text, f"t{keep}.mtx"))
+        assert ei.value.record["invariant"] == "entry-count"
+
+
+def test_truncation_at_every_byte_offset_never_silent(tmp_path):
+    """Property: a file cut at ANY byte offset inside the entry block
+    either raises the structured error or still parses to exactly the
+    declared nnz with in-bounds indices (a text format cannot detect a
+    cut that lands on a shorter-but-valid numeral — "0.5" → "0" — but it
+    must never yield a wrong-shaped or out-of-bounds result)."""
+    entries_start = GOOD.index("1 1 2.0")
+    for cut in range(entries_start, len(GOOD)):
+        path = _write(tmp_path, GOOD[:cut], f"c{cut}.mtx")
+        try:
+            rows, cols, vals, (nr, nc) = read_matrix_market(path)
+        except DataValidationError:
+            continue
+        assert len(rows) == len(cols) == len(vals) == 5
+        assert ((0 <= rows) & (rows < nr)).all()
+        assert ((0 <= cols) & (cols < nc)).all()
+        assert np.isfinite(vals).all()
+
+
+def test_extra_entries_rejected(tmp_path):
+    text = GOOD + "2 3 9.0\n"  # one more entry than the header declares
+    with pytest.raises(DataValidationError) as ei:
+        read_matrix_market(_write(tmp_path, text))
+    assert ei.value.record["invariant"] == "entry-count"
+
+
+def test_symmetric_upper_triangle_rejected(tmp_path):
+    text = ("%%MatrixMarket matrix coordinate real symmetric\n"
+            "2 2 2\n"
+            "1 1 5.0\n"
+            "1 2 7.0\n")  # upper-triangle entry in a symmetric file
+    with pytest.raises(DataValidationError) as ei:
+        read_matrix_market(_write(tmp_path, text))
+    assert ei.value.record["invariant"] == "symmetry"
+
+
+def test_pattern_field_two_columns(tmp_path):
+    text = ("%%MatrixMarket matrix coordinate pattern general\n"
+            "2 2 2\n"
+            "1 1\n"
+            "2 2\n")
+    rows, cols, vals, shape = read_matrix_market(_write(tmp_path, text))
+    np.testing.assert_array_equal(vals, [1.0, 1.0])
+
+
+def test_validate_csr_invariants():
+    shape = (3, 4)
+    indptr = np.array([0, 2, 3, 5], np.int64)
+    indices = np.array([0, 3, 1, 0, 2], np.int64)
+    data = np.array([2.0, 0.5, 3.0, -1.0, 4.0], np.float32)
+    validate_csr(indptr, indices, data, shape)  # pristine passes
+
+    cases = [
+        (np.array([0, 2, 1, 5], np.int64), indices, data,
+         "indptr-monotone"),
+        (np.array([1, 2, 3, 5], np.int64), indices, data,
+         "indptr-origin"),
+        (np.array([0, 2, 3, 4], np.int64), indices, data,
+         "nnz-consistency"),
+        (np.array([0, 2, 3], np.int64), indices, data, "indptr-length"),
+        (indptr, np.array([0, 3, 1, 0, 4], np.int64), data,
+         "column-bounds"),
+        (indptr, indices, np.array([2.0, 0.5, np.nan, -1.0, 4.0],
+                                   np.float32), "value-finiteness"),
+    ]
+    for p, i, d, invariant in cases:
+        with pytest.raises(DataValidationError) as ei:
+            validate_csr(p, i, d, shape)
+        assert ei.value.record["invariant"] == invariant, invariant
+
+
+def test_coo_to_csr_roundtrip_random():
+    """Random COO sets → CSR always satisfies the invariants and
+    preserves every (row, col, value) triplet."""
+    rng = np.random.default_rng(0)
+    for trial in range(10):
+        nr, nc = rng.integers(1, 20, size=2)
+        nnz = int(rng.integers(0, nr * nc))
+        rows = rng.integers(0, nr, size=nnz).astype(np.int64)
+        cols = rng.integers(0, nc, size=nnz).astype(np.int64)
+        vals = rng.standard_normal(nnz).astype(np.float32)
+        indptr, indices, data = coo_to_csr(rows, cols, vals, (nr, nc))
+        validate_csr(indptr, indices, data, (int(nr), int(nc)))
+        got = sorted(zip(np.repeat(np.arange(nr), np.diff(indptr)),
+                         indices, data))
+        want = sorted(zip(rows, cols, vals))
+        assert [(r, c) for r, c, _ in got] == [(r, c) for r, c, _ in want]
+        np.testing.assert_allclose(sorted(v for _, _, v in got),
+                                   sorted(v for _, _, v in want))

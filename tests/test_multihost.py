@@ -16,6 +16,19 @@ import textwrap
 
 import pytest
 
+from cme213_tpu.dist.multihost import (MULTIPROCESS_UNSUPPORTED_MSG,
+                                       multiprocess_unsupported)
+
+
+def _gate_multiprocess_capability(output: str) -> None:
+    """Explicit-skip a run that died on this jaxlib's missing multiprocess-
+    CPU capability (the probed error string is exact); anything else falls
+    through to the test's own hard assertions."""
+    if multiprocess_unsupported(output):
+        pytest.skip(f"backend capability: {MULTIPROCESS_UNSUPPORTED_MSG} "
+                    f"(this jaxlib); cross-process collectives need a real "
+                    f"multi-host backend")
+
 
 def test_env_parsing_defaults(monkeypatch):
     """Launcher env vars are the argument source, like MPI ranks."""
@@ -136,6 +149,9 @@ def test_two_process_cpu_backend(tmp_path):
                     "(coordinator handshake timed out); run manually with "
                     "JAX_COORDINATOR_ADDRESS=127.0.0.1:<port> "
                     "JAX_NUM_PROCESSES=2 JAX_PROCESS_ID={0,1}")
+    if any(rc != 0 for rc, _, _ in outs):
+        _gate_multiprocess_capability(
+            "".join(out + err for _, out, err in outs))
     for rc, out, err in outs:
         assert rc == 0, f"worker failed: {err[-2000:]}"
         assert "OK psum=10.0" in out
@@ -233,11 +249,14 @@ _SCAN_WORKER = textwrap.dedent("""
 """)
 
 
-def test_launcher_distributed_scan_two_ranks(tmp_path):
+def test_launcher_distributed_scan_two_ranks(tmp_path, capsys):
     """The long-context path (sharded segmented scan, ring carries) across
     two REAL processes: collectives ride the cross-process runtime, each
     rank checks its addressable shards against the host golden."""
-    assert _run_launcher(tmp_path, _SCAN_WORKER, devices_per_proc=4) == 0
+    rc = _run_launcher(tmp_path, _SCAN_WORKER, devices_per_proc=4)
+    if rc != 0:
+        _gate_multiprocess_capability(capsys.readouterr().out)
+    assert rc == 0
 
 
 _HEAT_WORKER = textwrap.dedent("""
@@ -274,8 +293,11 @@ _HEAT_WORKER = textwrap.dedent("""
 """)
 
 
-def test_launcher_distributed_heat_two_ranks(tmp_path):
+def test_launcher_distributed_heat_two_ranks(tmp_path, capsys):
     """The hw5 backbone — ppermute halo exchange + sharded stencil — across
     two REAL processes, shard-checked bitwise against the single-device
     solve (the reference's N-rank-vs-1-rank methodology, for real)."""
-    assert _run_launcher(tmp_path, _HEAT_WORKER, devices_per_proc=4) == 0
+    rc = _run_launcher(tmp_path, _HEAT_WORKER, devices_per_proc=4)
+    if rc != 0:
+        _gate_multiprocess_capability(capsys.readouterr().out)
+    assert rc == 0

@@ -1,0 +1,247 @@
+"""Gang supervision: heartbeat plumbing, stall detection, and the full
+supervised-launcher ladder — a rank killed mid-solve (deterministic
+``rankkill`` injection) or frozen mid-"collective" (simulated hang) is
+detected, the WHOLE gang is killed and relaunched, and the workload
+resumes from the last committed epoch with a bitwise-clean final grid.
+
+The end-to-end runs use a 1-process gang over 2 fake CPU devices — real
+halo-exchange collectives inside the rank, real process death, real
+launcher supervision — because this jaxlib has no multiprocess CPU
+collectives (the capability the gated tests in test_multihost.py probe);
+the supervision/commit protocol is identical at np>1.
+"""
+
+import os
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from cme213_tpu.core import faults, trace
+from cme213_tpu.dist.supervisor import (GangSupervisor, HeartbeatWriter,
+                                        heartbeat_from_env, read_heartbeat)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    trace.clear_events()
+    yield
+    faults.reset()
+
+
+# ------------------------------------------------------------ heartbeats
+
+def test_heartbeat_roundtrip(tmp_path):
+    hb = HeartbeatWriter(str(tmp_path), rank=3)
+    hb.beat(7)
+    rec = read_heartbeat(str(tmp_path), 3)
+    assert rec["rank"] == 3 and rec["step"] == 7
+    assert rec["pid"] == os.getpid() and rec["incarnation"] == 0
+
+
+def test_heartbeat_step_change_always_publishes(tmp_path):
+    hb = HeartbeatWriter(str(tmp_path), rank=0, interval=3600)
+    hb.beat(1)
+    hb.beat(2)  # interval must not suppress a step CHANGE
+    assert read_heartbeat(str(tmp_path), 0)["step"] == 2
+
+
+def test_heartbeat_same_step_throttled(tmp_path):
+    hb = HeartbeatWriter(str(tmp_path), rank=0, interval=3600)
+    hb.beat(1)
+    t0 = os.path.getmtime(hb.path)
+    rec0 = read_heartbeat(str(tmp_path), 0)
+    hb.beat(1)  # same step inside the interval: no rewrite
+    assert os.path.getmtime(hb.path) == t0
+    assert read_heartbeat(str(tmp_path), 0) == rec0
+
+
+def test_heartbeat_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("CME213_HEARTBEAT_DIR", raising=False)
+    assert heartbeat_from_env() is None
+    monkeypatch.setenv("CME213_HEARTBEAT_DIR", str(tmp_path))
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    monkeypatch.setenv("CME213_HEARTBEAT_INTERVAL", "0.5")
+    hb = heartbeat_from_env()
+    hb.beat(4)
+    assert read_heartbeat(str(tmp_path), 2)["step"] == 4
+    assert hb.interval == 0.5
+
+
+def test_missing_heartbeat_reads_none(tmp_path):
+    assert read_heartbeat(str(tmp_path), 9) is None
+
+
+# ------------------------------------------------------- stall detection
+
+def test_supervisor_distinguishes_progress_from_frozen(tmp_path):
+    sup = GangSupervisor(str(tmp_path), num_ranks=2, stall_timeout=0.15)
+    hb0 = HeartbeatWriter(str(tmp_path), 0)
+    hb1 = HeartbeatWriter(str(tmp_path), 1)
+    hb0.beat(1)
+    hb1.beat(1)
+    assert sup.stalled() == []          # first beats: progress
+    time.sleep(0.2)
+    hb0.beat(2)                         # rank 0 advances; rank 1 frozen
+    stalled = sup.stalled()
+    assert [s["rank"] for s in stalled] == [1]
+    assert stalled[0]["step"] == 1 and stalled[0]["stalled_s"] >= 0.15
+
+
+def test_supervisor_catches_rank_that_never_beat(tmp_path):
+    """A rank wedged before its first beat (hung coordinator handshake) is
+    timed from gang spawn."""
+    sup = GangSupervisor(str(tmp_path), num_ranks=1, stall_timeout=0.1)
+    assert sup.stalled() == []
+    time.sleep(0.15)
+    assert [s["rank"] for s in sup.stalled()] == [0]
+
+
+def test_supervisor_reset_clears_stale_beats(tmp_path):
+    sup = GangSupervisor(str(tmp_path), num_ranks=1, stall_timeout=0.1)
+    HeartbeatWriter(str(tmp_path), 0).beat(5)
+    assert sup.step_of(0) == 5
+    sup.reset()
+    assert sup.step_of(0) is None       # previous incarnation's beat gone
+    assert sup.stalled() == []          # and the progress clock restarted
+
+
+# ------------------------------------------------- supervised launcher
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The supervised heat worker: a full distributed solve on 2 fake devices,
+# epoch commits + heartbeats from the launcher env, final grid dumped
+# full-precision for the bitwise check.
+_HEAT_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from cme213_tpu.config import SimParams
+    from cme213_tpu.apps.heat2d import run_distributed_supervised
+
+    params = SimParams(nx=32, ny=32, order=4, iters=8)
+    out = run_distributed_supervised(params)
+    np.save({out_npy!r}, out)
+""")
+
+# A rank that heartbeats through step 1 then freezes forever in its first
+# incarnation — the hung-collective signature (step counter stops while
+# the process stays alive); the relaunched incarnation completes.
+_STALL_WORKER = textwrap.dedent("""
+    import sys, time
+    sys.path.insert(0, {repo!r})
+    from cme213_tpu.core.faults import incarnation
+    from cme213_tpu.dist.supervisor import heartbeat_from_env
+
+    hb = heartbeat_from_env()
+    hb.beat(1)
+    if incarnation() == 0:
+        time.sleep(600)   # frozen: alive, but the step never advances
+    hb.beat(2)
+    print("recovered incarnation", incarnation())
+""")
+
+
+def _write_worker(tmp_path, src, **fmt):
+    script = tmp_path / "worker.py"
+    script.write_text(src.format(repo=_REPO, **fmt))
+    return str(script)
+
+
+def test_gang_rank_kill_restarts_and_recovers_bitwise(tmp_path, monkeypatch,
+                                                      capsys):
+    """The acceptance ladder: rankkill fires at epoch 1 (one commit
+    banked), the launcher sees the rank die, condemns and relaunches the
+    gang, the workload elastically resumes from the committed epoch, and
+    the final grid is bitwise-equal to an uninterrupted sync-path run."""
+    from cme213_tpu.config import SimParams
+    from cme213_tpu.dist import make_mesh_1d, run_distributed_heat
+    from cme213_tpu.dist.launch import launch_supervised
+
+    out_npy = str(tmp_path / "final.npy")
+    worker = _write_worker(tmp_path, _HEAT_WORKER, out_npy=out_npy)
+    monkeypatch.setenv("CME213_FAULTS", "rankkill:0:1")
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    rc = launch_supervised(
+        1, [sys.executable, worker], devices_per_proc=2,
+        stall_timeout=120, max_restarts=1,
+        ckpt_dir=str(tmp_path / "ckpt"), ckpt_every=2, timeout=300)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "injected kill: rank 0" in out
+    assert "condemning the gang" in out
+    assert "gang restart (incarnation 1/1)" in out
+
+    params = SimParams(nx=32, ny=32, order=4, iters=8)
+    ref = run_distributed_heat(params, make_mesh_1d(2))
+    np.testing.assert_array_equal(np.load(out_npy), ref)
+    assert trace.events("rank-failed")[-1]["reason"] == "exit"
+    assert trace.events("gang-restart")[-1]["incarnation"] == 1
+
+
+def test_gang_stall_detected_and_restarted(tmp_path, capsys):
+    """A rank alive but frozen (step counter stuck) is condemned by
+    --stall-timeout — not by the whole-job --timeout — and the relaunched
+    incarnation completes."""
+    from cme213_tpu.dist.launch import launch_supervised
+
+    worker = _write_worker(tmp_path, _STALL_WORKER)
+    t0 = time.monotonic()
+    rc = launch_supervised(1, [sys.executable, worker],
+                           stall_timeout=1.0, max_restarts=1, timeout=120)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert time.monotonic() - t0 < 60  # stall clock, not the job deadline
+    assert "stalled at step 1" in out
+    assert "recovered incarnation 1" in out
+    assert trace.events("rank-failed")[-1]["reason"] == "stall"
+    assert trace.events("gang-restart")
+
+
+def test_gang_restart_budget_exhausted_fails(tmp_path, monkeypatch):
+    from cme213_tpu.dist.launch import launch_supervised
+
+    script = tmp_path / "die.py"
+    script.write_text(
+        f"import sys; sys.path.insert(0, {_REPO!r})\n"
+        "from cme213_tpu.core import faults\n"
+        "faults.maybe_kill_rank(step=0)\n")
+    monkeypatch.setenv("CME213_FAULTS", "rankkill:0:0")
+    rc = launch_supervised(1, [sys.executable, str(script)],
+                           max_restarts=0, stall_timeout=60, timeout=60)
+    assert rc == faults.KILL_EXIT
+
+
+def test_gang_clean_exit_is_zero(tmp_path):
+    from cme213_tpu.dist.launch import launch_supervised
+
+    script = tmp_path / "ok.py"
+    script.write_text("print('fine')\n")
+    rc = launch_supervised(2, [sys.executable, str(script)],
+                           stall_timeout=60, timeout=60)
+    assert rc == 0
+
+
+def test_launcher_cli_supervised_flags(tmp_path, capsys):
+    """--stall-timeout routes main() into supervised mode, and the ckpt
+    plumbing env reaches the ranks."""
+    from cme213_tpu.dist.launch import main
+
+    script = tmp_path / "env.py"
+    script.write_text(
+        "import os\n"
+        "print('CKPT', os.environ['CME213_CKPT_DIR'],\n"
+        "      os.environ['CME213_CKPT_EVERY'],\n"
+        "      os.environ['CME213_RESUME'],\n"
+        "      'HB' in os.environ['CME213_HEARTBEAT_DIR'] or\n"
+        "      os.environ['CME213_HEARTBEAT_DIR'])\n")
+    rc = main(["--np", "1", "--stall-timeout", "30",
+               "--ckpt-dir", str(tmp_path / "c"), "--ckpt-every", "5",
+               "--heartbeat-interval", "0.5", "--timeout", "60", "--",
+               sys.executable, str(script)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"CKPT {tmp_path / 'c'} 5 0" in out
