@@ -292,7 +292,7 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
     ladder bookkeeping run in host Python before the jitted loop launches,
     so the healthy path times identically.
     """
-    from ..core import check_op, with_fallback
+    from ..core import check_op, span, with_fallback
 
     prob.validate()
     xx = jnp.asarray(prob.xx, dtype)
@@ -309,11 +309,17 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
             # warmup compile outside the timed region (the CUDA analog
             # timed only kernel execution between cudaEvents); the named
             # barrier forces compile/runtime failures to surface HERE,
-            # attributed to the rung, before the timed phase opens
-            check_op(f"spmv_scan.{rung}", runner(jnp.zeros_like(a)))
-            with timer.phase("spmv_scan") as ph:
-                out = runner(a)
-                ph.block(out)
+            # attributed to the rung, before the timed phase opens —
+            # spans split compile from run time per rung, so trace
+            # summaries separate the two the way the reference's warmup
+            # discipline did implicitly
+            with span("spmv_scan.compile", kernel=rung):
+                check_op(f"spmv_scan.{rung}", runner(jnp.zeros_like(a)))
+            with span("spmv_scan.run", kernel=rung, n=prob.n,
+                      iters=prob.iters):
+                with timer.phase("spmv_scan") as ph:
+                    out = runner(a)
+                    ph.block(out)
             return out
         return thunk
 

@@ -14,6 +14,13 @@ The exit code stays meaningful to the capture layer (``tpu_capture.sh``
 writes retryable ``.failed`` markers off it): 0 when every sweep
 ultimately produced rows — even if some needed their retry — and 1 only
 when a sweep failed both attempts.
+
+Telemetry: each completed sweep emits a ``sweep-complete`` trace event
+and its metrics-registry delta (demotions, served rungs, retries, span
+histograms — ``core/metrics.py``) is attached to that sweep's row set in
+``<out>/metrics.json``, keyed by sweep name.  The deltas ride in a
+sidecar instead of extra CSV columns so the banked-CSV comparators and
+the capture layer's shell parsers keep seeing the schema they pin.
 """
 
 from __future__ import annotations
@@ -22,6 +29,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 
 def main(argv=None) -> int:
@@ -114,15 +122,18 @@ def main(argv=None) -> int:
             print(f"--only: unknown sweep name(s) {sorted(unknown)}; "
                   f"choose from {sorted(known)}", file=sys.stderr)
             return 2
-    from ..core import faults, trace
+    from ..core import faults, metrics, trace
 
     failed, retried = [], []
+    sweep_metrics: dict[str, dict] = {}
     for fname, job in jobs:
         if only is not None and fname[:-len(".csv")] not in only:
             continue
         name = fname[:-len(".csv")]
         path = os.path.join(args.out, fname)
         rows = None
+        before = metrics.snapshot()
+        t0 = time.perf_counter()
         for attempt in (1, 2):  # one retry: a flake can't zero the capture
             try:
                 faults.maybe_fail(f"sweep.{name}")
@@ -139,11 +150,19 @@ def main(argv=None) -> int:
                                    error=type(e).__name__)
         if rows is None:
             continue
+        ms = round((time.perf_counter() - t0) * 1e3, 1)
+        trace.record_event("sweep-complete", sweep=name, rows=len(rows),
+                           ms=ms)
+        sweep_metrics[name] = {"rows": len(rows), "ms": ms,
+                               "metrics": metrics.delta(before,
+                                                        metrics.snapshot())}
         sweeps.write_csv(rows, path)
         print(f"{path}: {len(rows)} rows")
     manifest = {"failed": failed, "retried": retried}
     with open(os.path.join(args.out, "failures.json"), "w") as f:
         json.dump(manifest, f, indent=2)
+    with open(os.path.join(args.out, "metrics.json"), "w") as f:
+        json.dump(sweep_metrics, f, indent=2, default=str)
     # nonzero only on a sweep failing BOTH attempts, so callers
     # (tpu_capture.sh) can record a sticky-vs-device failure instead of
     # seeing a green exit; retry-recovered flakes exit 0 and are still

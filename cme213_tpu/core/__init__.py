@@ -4,7 +4,9 @@ from .errors import DataValidationError, check_op, FrameworkError
 from .resilience import (FailureKind, FallbackResult, NonFiniteError,
                          RetryPolicy, all_finite, classify_failure,
                          with_fallback)
-from .trace import clear_events, events, record_event
+from .trace import (EVENT_SCHEMA, clear_events, events, flush_sink,
+                    record_event, span, validate_record)
+from . import metrics
 
 __all__ = [
     "PhaseTimer",
@@ -25,4 +27,9 @@ __all__ = [
     "record_event",
     "events",
     "clear_events",
+    "span",
+    "flush_sink",
+    "validate_record",
+    "EVENT_SCHEMA",
+    "metrics",
 ]

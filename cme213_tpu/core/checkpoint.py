@@ -37,7 +37,8 @@ import zlib
 
 import numpy as np
 
-from .trace import record_event
+from . import metrics
+from .trace import record_event, span
 
 #: suffix of quarantined (corrupt) checkpoint files
 CORRUPT_SUFFIX = ".corrupt"
@@ -131,6 +132,7 @@ def load_checkpoint(path: str):
                 OSError, EOFError) as e:
             quarantine = candidate + CORRUPT_SUFFIX
             os.replace(candidate, quarantine)
+            metrics.counter("checkpoint.quarantines").inc()
             record_event("checkpoint-quarantine", path=candidate,
                          quarantined_to=quarantine,
                          error=type(e).__name__, message=str(e)[:200])
@@ -206,7 +208,8 @@ def run_with_checkpoints(step_fn, state, total_iters: int, path: str,
     retries = 0
     while it < total_iters:
         k = min(every, total_iters - it)
-        new_state = maybe_poison(op, step_fn(state, k))
+        with span("checkpoint.chunk", op=op, start=it, iters=k):
+            new_state = maybe_poison(op, step_fn(state, k))
         if guard is not None and not guard(new_state):
             record_event("numeric-abort", op=op, step=it + k,
                          retries=retries)
@@ -222,10 +225,12 @@ def run_with_checkpoints(step_fn, state, total_iters: int, path: str,
                     f"checkpoint to roll back to")
             it, arrays = loaded
             state = _unflatten_state(arrays)
+            metrics.counter("checkpoint.rollbacks").inc()
             record_event("checkpoint-rollback", op=op, resumed_step=it,
                          retries=retries)
             continue
         state = new_state
         it += k
-        save_state_checkpoint(path, it, state)
+        with span("checkpoint.save", op=op, step=it):
+            save_state_checkpoint(path, it, state)
     return state

@@ -170,8 +170,10 @@ def injected(spec: str):
 
 
 def _record(kind: str, op: str, **fields) -> None:
+    from .metrics import counter
     from .trace import record_event
 
+    counter(f"faults.{kind}").inc()
     record_event("fault-injected", kind=kind, op=op, **fields)
 
 

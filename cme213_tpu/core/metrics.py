@@ -1,0 +1,203 @@
+"""In-process metrics registry — named counters, gauges, histograms.
+
+The reference derived every metric offline, in spreadsheets over printed
+timer lines (SURVEY §5); a production system pulls named metrics from the
+process instead (the Prometheus model).  This registry is that pull
+surface, deliberately tiny: no label sets, no exposition server — just
+named instruments a solver increments on its host path and a
+``snapshot()`` the bench harness (``bench/run_all.py``) and the trace
+sink (a ``metrics-snapshot`` event at exit) serialize::
+
+    from cme213_tpu.core import metrics
+    metrics.counter("fallback.demotions").inc()
+    metrics.histogram("commit.ms").observe(12.3)
+    metrics.gauge("gang.world").set(4)
+
+Instruments are created on first use and process-global; snapshotting is
+lock-consistent.  Histograms keep a bounded ring of recent observations
+(``KEEP`` = 4096) for percentiles plus exact count/sum — a long solve
+cannot grow memory without bound.  Everything here is host-side dict and
+deque work: effectively free next to any device work it measures, and
+exactly zero when never called.
+
+``delta(before, after)`` diffs two snapshots (counter/histogram-count
+deltas, latest gauge values) — what ``run_all`` attaches to each sweep's
+row set in ``metrics.json``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from collections import deque
+
+#: observations retained per histogram for percentile estimates
+KEEP = 4096
+
+_LOCK = threading.Lock()
+_COUNTERS: dict[str, "Counter"] = {}
+_GAUGES: dict[str, "Gauge"] = {}
+_HISTOGRAMS: dict[str, "Histogram"] = {}
+
+
+class Counter:
+    """Monotonic named count (demotions, retries, commits, faults)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> "Counter":
+        with _LOCK:
+            self.value += n
+        return self
+
+
+class Gauge:
+    """Last-write-wins named value (world size, live epoch, ...)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.value = None
+
+    def set(self, value) -> "Gauge":
+        with _LOCK:
+            self.value = value
+        return self
+
+
+class Histogram:
+    """Named distribution: exact count/sum/min/max plus percentiles over
+    the last ``KEEP`` observations (a ring — bounded by construction)."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_recent")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._recent: deque = deque(maxlen=KEEP)
+
+    def observe(self, value: float) -> "Histogram":
+        value = float(value)
+        with _LOCK:
+            self.count += 1
+            self.total += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+            self._recent.append(value)
+        return self
+
+    def percentile(self, q: float) -> float | None:
+        """Nearest-rank percentile (q in [0, 1]) over retained
+        observations; None when empty."""
+        with _LOCK:
+            vals = sorted(self._recent)
+        if not vals:
+            return None
+        idx = min(len(vals) - 1, max(0, round(q * (len(vals) - 1))))
+        return vals[idx]
+
+    def _summary_locked(self) -> dict:
+        vals = sorted(self._recent)
+
+        def pct(q):
+            if not vals:
+                return None
+            return vals[min(len(vals) - 1, max(0, round(q * (len(vals) - 1))))]
+
+        return {
+            "count": self.count,
+            "sum": round(self.total, 6),
+            "mean": round(self.total / self.count, 6) if self.count else None,
+            "min": self.min,
+            "max": self.max,
+            "p50": pct(0.50),
+            "p90": pct(0.90),
+            "p99": pct(0.99),
+        }
+
+
+def counter(name: str) -> Counter:
+    with _LOCK:
+        c = _COUNTERS.get(name)
+        if c is None:
+            c = _COUNTERS[name] = Counter(name)
+    return c
+
+
+def gauge(name: str) -> Gauge:
+    with _LOCK:
+        g = _GAUGES.get(name)
+        if g is None:
+            g = _GAUGES[name] = Gauge(name)
+    return g
+
+
+def histogram(name: str) -> Histogram:
+    with _LOCK:
+        h = _HISTOGRAMS.get(name)
+        if h is None:
+            h = _HISTOGRAMS[name] = Histogram(name)
+    return h
+
+
+def snapshot() -> dict:
+    """Lock-consistent ``{counters, gauges, histograms}`` view of the
+    registry — JSON-serializable (what trace files and bench artifacts
+    embed)."""
+    with _LOCK:
+        return {
+            "counters": {k: c.value for k, c in sorted(_COUNTERS.items())},
+            "gauges": {k: g.value for k, g in sorted(_GAUGES.items())},
+            "histograms": {k: h._summary_locked()
+                           for k, h in sorted(_HISTOGRAMS.items())},
+        }
+
+
+def delta(before: dict, after: dict) -> dict:
+    """What changed between two snapshots: nonzero counter deltas, gauges
+    at their ``after`` values, histograms that saw new observations (with
+    their ``after`` percentiles — percentiles don't subtract)."""
+    counters = {}
+    for k, v in after.get("counters", {}).items():
+        d = v - before.get("counters", {}).get(k, 0)
+        if d:
+            counters[k] = d
+    histograms = {}
+    for k, h in after.get("histograms", {}).items():
+        d = h["count"] - before.get("histograms", {}).get(k, {}).get("count", 0)
+        if d:
+            histograms[k] = {**h, "count_delta": d}
+    return {"counters": counters,
+            "gauges": dict(after.get("gauges", {})),
+            "histograms": histograms}
+
+
+def reset() -> None:
+    """Forget every instrument (tests)."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _GAUGES.clear()
+        _HISTOGRAMS.clear()
+
+
+def _emit_exit_snapshot() -> None:
+    """At interpreter exit, append one ``metrics-snapshot`` event so sink
+    files end with the process's final registry state.  Skipped when the
+    registry was never touched (no instruments -> no record)."""
+    if not (_COUNTERS or _GAUGES or _HISTOGRAMS):
+        return
+    from .trace import flush_sink, record_event
+
+    record_event("metrics-snapshot", metrics=snapshot())
+    flush_sink()
+
+
+atexit.register(_emit_exit_snapshot)

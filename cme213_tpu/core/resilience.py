@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 from enum import Enum
 
+from . import metrics
 from .errors import FrameworkError
 from .faults import maybe_fail
 from .trace import record_event
@@ -117,6 +118,7 @@ class RetryPolicy:
                 last = e
                 if kind not in self.retry_on or attempt >= self.max_retries:
                     raise
+                metrics.counter("retry.attempts").inc()
                 record_event("retry", op=op, attempt=attempt + 1,
                              kind=kind.value, error=type(e).__name__,
                              next_delay_s=self.delays()[attempt])
@@ -170,10 +172,12 @@ def with_fallback(op: str, ladder, policy: RetryPolicy | None = None
             kind = classify_failure(e)
             failures.append(RungFailure(name, kind, type(e).__name__,
                                         str(e)[:300]))
+            metrics.counter("fallback.demotions").inc()
             record_event("rung-failed", op=op, rung=name, kind=kind.value,
                          error=type(e).__name__)
             last = e
             continue
+        metrics.counter(f"served.{op}.{name}").inc()
         record_event("served", op=op, rung=name, demoted=bool(failures),
                      failed_rungs=[f.rung for f in failures])
         return FallbackResult(value, name, failures)

@@ -7,6 +7,11 @@ TPU-native analog of the reference's harness utilities: ``event_pair`` +
 (``hw/hw5/programming/2dHeat.cpp:832-841``).  On TPU, device work is async, so
 the timer blocks on the provided arrays (``jax.block_until_ready``) before
 reading the clock — the analog of ``cudaEventSynchronize``.
+
+Every phase also emits a ``span-begin``/``span-end`` pair through
+``core/trace.span`` (same label, same blocking discipline), so any
+workload already instrumented with a ``PhaseTimer`` shows up in
+``python -m cme213_tpu trace summary`` for free.
 """
 
 from __future__ import annotations
@@ -40,28 +45,27 @@ class PhaseTimer:
     records: list[PhaseRecord] = field(default_factory=list)
     verbose: bool = False
 
-    class _Phase:
-        def __init__(self) -> None:
-            self._blocked = []
-
-        def block(self, *arrays) -> None:
-            for a in arrays:
-                self._blocked.append(a)
-
     @contextmanager
     def phase(self, label: str):
-        ph = PhaseTimer._Phase()
-        start = time.perf_counter()
-        try:
-            yield ph
-        finally:
-            for a in ph._blocked:
-                jax.block_until_ready(a)
-            ms = (time.perf_counter() - start) * 1e3
-            self.records.append(PhaseRecord(label, ms))
-            if self.verbose:
-                # labeled timing printout, like stop_timer's "%s took %.1f ms"
-                print(f"{label} took {ms:.1f} ms")
+        from .trace import span
+
+        # the phase clock starts after span-begin is emitted and stops
+        # before span-end is — record emission stays OUTSIDE the measured
+        # window, so phase timings match the pre-telemetry ones exactly
+        # (the span's own ms is marginally wider; that's its job)
+        with span(label) as ph:
+            start = time.perf_counter()
+            try:
+                yield ph
+            finally:
+                for a in ph._blocked:
+                    jax.block_until_ready(a)
+                ms = (time.perf_counter() - start) * 1e3
+                self.records.append(PhaseRecord(label, ms))
+                if self.verbose:
+                    # labeled timing printout, like stop_timer's
+                    # "%s took %.1f ms"
+                    print(f"{label} took {ms:.1f} ms")
 
     def ms(self, label: str) -> float:
         """Total milliseconds across all phases with this label."""

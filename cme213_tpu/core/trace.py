@@ -1,46 +1,232 @@
-"""Profiling/tracing hooks.
+"""Telemetry: trace spans, structured events, and hardened per-rank sinks.
 
-The reference's tracing is labeled phase timers around every stage plus
-offline derived metrics (SURVEY §5).  ``PhaseTimer`` covers that; this module
-adds the device-level profile the CUDA events couldn't give: a context
-manager around ``jax.profiler`` producing an XPlane trace (viewable in
-TensorBoard/Perfetto) for kernel-level overlap verification — which SURVEY §7
-calls out as the way "async" overlap must be verified on TPU.
+The reference instruments every workload with labeled phase timers —
+``event_pair`` + ``start_timer``/``stop_timer`` CUDA-event pairs
+(``hw/hw1/programming/mp1-util.h:21-39``), ``omp_get_wtime`` phases
+(``hw/hw4/programming/mergesort.cpp:168-184``), ``MPI_Wtime``
+(``hw/hw5/programming/2dHeat.cpp:832-841``) — and derives its metrics
+offline (SURVEY §5).  This module is the production form of that story,
+in three pieces:
 
-It also carries the structured event log of the resilience layer: op
-failures (``core/errors.check_op``), fallback-ladder demotions and retries
-(``core/resilience.py``), checkpoint quarantines (``core/checkpoint.py``)
-and injected faults (``core/faults.py``) all flow through ``record_event``
-as dicts, so capture logs can be grepped for machine-readable records
-instead of formatted strings.  Set ``CME213_TRACE_FILE`` to also append
-each event as a JSON line (the capture-log path).
+- **Structured events** (``record_event``): op failures
+  (``core/errors.check_op``), fallback-ladder demotions and retries
+  (``core/resilience.py``), checkpoint quarantines (``core/checkpoint.py``),
+  epoch commits (``dist/ckpt.py``), gang verdicts (``dist/launch.py``) and
+  injected faults (``core/faults.py``) all flow through here as dicts.
+  Every record carries process tags — ``pid``, ``rank``
+  (``JAX_PROCESS_ID``), ``incarnation`` (``CME213_INCARNATION``) — so
+  per-rank files can be merged back into one gang view.  The registry of
+  known event names and their required fields is :data:`EVENT_SCHEMA`
+  (pinned by a tier-1 test over every call site in the package).
+
+- **Spans** (``span``): causally-linked begin/end pairs in the Dapper
+  style — unique ids, parent links via a contextvar stack, monotonic
+  durations, and a ``.block(*arrays)`` hook that ``jax.block_until_ready``s
+  device work before the clock stops (the ``cudaEventSynchronize`` analog,
+  same discipline as ``core/timing.PhaseTimer`` — whose phases emit spans
+  automatically).  Span durations also feed the metrics registry
+  (``core/metrics.py``) as ``span.<name>.ms`` histograms.
+
+- **Sinks**: set ``CME213_TRACE_FILE`` to append each record as a JSON
+  line.  The handle is opened once and cached (not reopened per event),
+  guarded by a lock, flushed per line (a hard-killed rank —
+  ``os._exit`` — keeps everything it recorded) and closed at exit.  A
+  ``{rank}`` placeholder in the path is expanded per process (the
+  launcher templates it for workers; this module resolves any remainder
+  from ``JAX_PROCESS_ID``, or ``main`` for non-rank processes), so gang
+  members never interleave into one file.  ``CME213_TRACE_BUFFER`` caps
+  the in-process event list as a ring buffer (default unbounded — the
+  historical behavior tests rely on).
+
+Offline analysis: ``python -m cme213_tpu trace summary|timeline|merge``
+(``trace_cli.py``) over one or many sink files.  With no sink configured,
+an event is one dict append under a lock — effectively free next to any
+device work it annotates.
+
+``device_trace`` is unchanged: the kernel-level XPlane profile
+(TensorBoard/Perfetto) for overlap verification, which spans deliberately
+do not replace.
 """
 
 from __future__ import annotations
 
+import atexit
+import contextvars
+import itertools
 import json
 import os
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 
-_EVENTS: list[dict] = []
+#: JSON-lines sink path; may contain a ``{rank}`` placeholder
+TRACE_FILE_ENV = "CME213_TRACE_FILE"
+#: ring-buffer cap on the in-process event list (0/unset = unbounded)
+TRACE_BUFFER_ENV = "CME213_TRACE_BUFFER"
+
+#: Known event names -> required fields (beyond the automatic
+#: event/t/pid/rank/incarnation tags).  ``tests/test_telemetry.py``
+#: statically checks every ``record_event`` call site in the package
+#: against this table; ``trace_cli.py`` validates records offline.
+EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
+    # op barriers / ingestion (core/errors.py)
+    "op-failure": ("op", "error", "ms", "message"),
+    "data-validation": ("source", "invariant", "detail"),
+    # resilience ladder (core/resilience.py)
+    "retry": ("op", "attempt", "kind", "error", "next_delay_s"),
+    "rung-failed": ("op", "rung", "kind", "error"),
+    "served": ("op", "rung", "demoted", "failed_rungs"),
+    # fault injection (core/faults.py)
+    "fault-injected": ("kind", "op"),
+    # single-process checkpoints (core/checkpoint.py)
+    "checkpoint-quarantine": ("path", "quarantined_to", "error", "message"),
+    "numeric-abort": ("op", "step", "retries"),
+    "checkpoint-rollback": ("op", "resumed_step", "retries"),
+    # bench harness (bench/run_all.py)
+    "sweep-failed": ("sweep", "attempt", "error"),
+    "sweep-complete": ("sweep", "rows", "ms"),
+    # distributed commits (dist/ckpt.py)
+    "epoch-commit": ("epoch", "step", "world", "shards", "ms"),
+    "commit-invalid": ("candidate", "error", "message"),
+    "commit-loaded": ("epoch", "step", "candidate"),
+    # gang supervision (dist/launch.py, dist/supervisor.py)
+    "rank-failed": ("rank", "reason", "incarnation"),
+    "gang-restart": ("incarnation", "reason", "rank"),
+    "gang-launch": ("incarnation", "world", "coordinator"),
+    "gang-exit": ("incarnation", "rc"),
+    "heartbeat": ("rank", "step"),
+    # telemetry itself
+    "span-begin": ("span", "id", "parent"),
+    "span-end": ("span", "id", "parent", "ms"),
+    "metrics-snapshot": ("metrics",),
+}
+
+
+def validate_record(rec: dict) -> list[str]:
+    """Required fields missing from ``rec`` for its (known) event name;
+    ``[]`` when the record is valid or the event name is unregistered."""
+    required = EVENT_SCHEMA.get(rec.get("event", ""))
+    if not required:
+        return []
+    return [k for k in required if k not in rec]
+
+
 _LOCK = threading.Lock()
+_EVENTS: deque = deque()
+_BUFFER_CONFIGURED = False
+
+_SINK_PATH: str | None = None   # resolved path the cached handle points at
+_SINK_FILE = None
+_ATEXIT_INSTALLED = False
+
+
+def _proc_tags() -> dict:
+    """The per-record process tags (pid/rank/incarnation) that let
+    ``trace merge`` reconstruct a gang view from per-rank files."""
+    rank = os.environ.get("JAX_PROCESS_ID")
+    return {
+        "pid": os.getpid(),
+        "rank": int(rank) if rank is not None else None,
+        "incarnation": int(os.environ.get("CME213_INCARNATION", "0") or 0),
+    }
+
+
+def format_trace_path(template: str, rank) -> str:
+    """Expand the ``{rank}`` placeholder of a sink-path template."""
+    return template.replace("{rank}", str(rank))
+
+
+def _resolve_sink_path() -> str | None:
+    path = os.environ.get(TRACE_FILE_ENV)
+    if not path:
+        return None
+    if "{rank}" in path:
+        # launcher children get a concrete path from dist/launch.py; this
+        # fallback covers processes using the template env directly
+        path = format_trace_path(
+            path, os.environ.get("JAX_PROCESS_ID", "main"))
+    return path
+
+
+def _sink_file():
+    """The cached append handle for the current sink path (caller holds
+    ``_LOCK``).  Re-resolved per event only by string compare, so a test
+    flipping the env (or a ``flush_sink``) rotates the handle; a broken
+    sink caches ``None`` and is never retried until the path changes."""
+    global _SINK_PATH, _SINK_FILE, _ATEXIT_INSTALLED
+    path = _resolve_sink_path()
+    if path != _SINK_PATH:
+        if _SINK_FILE is not None:
+            try:
+                _SINK_FILE.close()
+            except OSError:
+                pass
+        _SINK_FILE = None
+        _SINK_PATH = path
+        if path:
+            try:
+                _SINK_FILE = open(path, "a")
+            except OSError:
+                _SINK_FILE = None  # broken sink must never kill the workload
+        if not _ATEXIT_INSTALLED:
+            atexit.register(flush_sink)
+            _ATEXIT_INSTALLED = True
+    return _SINK_FILE
+
+
+def flush_sink() -> None:
+    """Flush and close the cached sink handle (reopened lazily by the
+    next event).  Registered atexit; also the test hook for rotating the
+    handle after an env change without recording an event."""
+    global _SINK_PATH, _SINK_FILE
+    with _LOCK:
+        if _SINK_FILE is not None:
+            try:
+                _SINK_FILE.flush()
+                _SINK_FILE.close()
+            except OSError:
+                pass
+        _SINK_FILE = None
+        _SINK_PATH = None
+
+
+def _buffer() -> deque:
+    """The in-process event buffer, ring-capped by ``CME213_TRACE_BUFFER``
+    (read once; ``clear_events`` re-reads).  Caller holds ``_LOCK``."""
+    global _EVENTS, _BUFFER_CONFIGURED
+    if not _BUFFER_CONFIGURED:
+        raw = os.environ.get(TRACE_BUFFER_ENV, "")
+        try:
+            cap = int(raw) if raw.strip() else 0
+        except ValueError:
+            cap = 0
+        if cap > 0 and _EVENTS.maxlen != cap:
+            _EVENTS = deque(_EVENTS, maxlen=cap)
+        _BUFFER_CONFIGURED = True
+    return _EVENTS
 
 
 def record_event(event: str, **fields) -> dict:
     """Append a structured event to the in-process log (and the
-    ``CME213_TRACE_FILE`` JSON-lines sink, when set).  Returns the record."""
-    rec = {"event": event, "t": round(time.time(), 6), **fields}
+    ``CME213_TRACE_FILE`` JSON-lines sink, when set).  Returns the record.
+
+    Every record carries ``pid``/``rank``/``incarnation`` process tags
+    (explicit fields win, e.g. the launcher reporting on a worker's
+    rank).  Sink writes reuse one cached handle and flush per line, so a
+    rank hard-killed mid-solve (``os._exit``) loses nothing it recorded.
+    """
+    rec = {"event": event, "t": round(time.time(), 6),
+           **_proc_tags(), **fields}
     with _LOCK:
-        _EVENTS.append(rec)
-    path = os.environ.get("CME213_TRACE_FILE")
-    if path:
-        try:
-            with open(path, "a") as f:
+        _buffer().append(rec)
+        f = _sink_file()
+        if f is not None:
+            try:
                 f.write(json.dumps(rec, default=str) + "\n")
-        except OSError:
-            pass  # a broken sink must never take down the workload
+                f.flush()
+            except OSError:
+                pass  # a broken sink must never take down the workload
     return rec
 
 
@@ -54,8 +240,83 @@ def events(event: str | None = None) -> list[dict]:
 
 
 def clear_events() -> None:
+    """Drop recorded events and re-read the ring-buffer cap env."""
+    global _EVENTS, _BUFFER_CONFIGURED
     with _LOCK:
-        _EVENTS.clear()
+        _EVENTS = deque()
+        _BUFFER_CONFIGURED = False
+
+
+# ------------------------------------------------------------------ spans
+
+_SPAN_STACK: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "cme213_span_stack", default=())
+_SPAN_COUNTER = itertools.count(1)
+
+
+class SpanHandle:
+    """Yielded by ``span``: ``.block(*arrays)`` registers device arrays to
+    ``jax.block_until_ready`` before the span's clock stops — async device
+    work is attributed to the span that launched it, like the reference's
+    ``cudaEventSynchronize`` before ``stop_timer``."""
+
+    __slots__ = ("_blocked",)
+
+    def __init__(self) -> None:
+        self._blocked: list = []
+
+    def block(self, *arrays) -> None:
+        for a in arrays:
+            self._blocked.append(a)
+
+
+def current_span_id() -> str | None:
+    """Id of the innermost open span in this context (None outside any)."""
+    stack = _SPAN_STACK.get()
+    return stack[-1] if stack else None
+
+
+@contextmanager
+def span(name: str, **tags):
+    """Trace the enclosed block as a ``span-begin``/``span-end`` pair.
+
+    Ids are unique across a gang (``<pid hex>.<counter>``); the parent
+    link comes from a contextvar stack, so nesting — including across
+    threads started inside a span — produces a causal tree ``trace
+    summary`` can aggregate.  ``tags`` ride on both records (kernel rung,
+    epoch number, ...).  The span-end carries the monotonic duration
+    ``ms`` (after blocking on any ``.block()``-registered arrays) and an
+    ``error`` tag when the block raised; the duration also feeds the
+    ``span.<name>.ms`` metrics histogram.
+    """
+    sid = f"{os.getpid():x}.{next(_SPAN_COUNTER)}"
+    stack = _SPAN_STACK.get()
+    parent = stack[-1] if stack else None
+    record_event("span-begin", span=name, id=sid, parent=parent, **tags)
+    token = _SPAN_STACK.set(stack + (sid,))
+    handle = SpanHandle()
+    err: str | None = None
+    start = time.perf_counter()
+    try:
+        yield handle
+        if handle._blocked:
+            import jax
+
+            for a in handle._blocked:
+                jax.block_until_ready(a)
+    except BaseException as e:
+        err = type(e).__name__
+        raise
+    finally:
+        ms = round((time.perf_counter() - start) * 1e3, 3)
+        _SPAN_STACK.reset(token)
+        end = dict(span=name, id=sid, parent=parent, ms=ms, **tags)
+        if err is not None:
+            end["error"] = err
+        record_event("span-end", **end)
+        from . import metrics
+
+        metrics.histogram(f"span.{name}.ms").observe(ms)
 
 
 @contextmanager

@@ -42,9 +42,10 @@ import time
 
 import numpy as np
 
+from ..core import metrics
 from ..core.checkpoint import CheckpointCorrupt, read_checkpoint, save_checkpoint
 from ..core.faults import maybe_fail_commit, maybe_truncate_file
-from ..core.trace import record_event
+from ..core.trace import record_event, span
 
 #: manifest filename of the live committed epoch (atomic-replace published)
 COMMIT_NAME = "COMMIT"
@@ -144,41 +145,52 @@ def commit_epoch(ckpt_dir: str, epoch: int, step: int, array,
     solve's (ny, nx) under ghost padding) so elastic resume can trim before
     re-decomposing.  ``meta`` rides in the manifest verbatim for caller
     sanity checks (``check_meta``).
-    """
-    deadline = time.monotonic() + timeout
-    own = write_epoch_shards(ckpt_dir, epoch, step, array)
-    if process_id != 0:
-        _wait_for_commit(ckpt_dir, epoch, deadline)
-        return None
 
-    edir = os.path.join(ckpt_dir, epoch_dirname(epoch))
-    entries = []
-    for index in global_shard_map(array):
-        fname = shard_filename(index)
-        # validate every file by read-back — own shards included, so a torn
-        # local write aborts the commit here instead of poisoning resume
-        crc = _validate_shard(os.path.join(edir, fname), step, deadline)
-        entries.append({"file": fname, "index": [list(r) for r in index],
-                        "crc": crc})
-    manifest = {
-        "format": _FORMAT,
-        "epoch": int(epoch),
-        "step": int(step),
-        "world": int(process_count),
-        "epoch_dir": epoch_dirname(epoch),
-        "global_shape": [int(d) for d in array.shape],
-        "true_shape": [int(d) for d in true_shape],
-        "dtype": str(array.dtype),
-        "meta": dict(meta or {}),
-        "shards": entries,
-    }
-    # the crash window under test: shards durable, manifest not yet live
-    maybe_fail_commit()
-    _publish(ckpt_dir, manifest)
-    record_event("epoch-commit", epoch=int(epoch), step=int(step),
-                 world=int(process_count), shards=len(entries))
-    _gc_epochs(ckpt_dir)
-    return manifest
+    The whole protocol round runs inside a ``ckpt.commit`` span (per rank:
+    on followers it measures shard write + manifest wait); rank 0's
+    ``epoch-commit`` event and the ``commit.ms`` histogram carry the
+    write→validate→publish latency the ``trace`` CLI reports percentiles
+    over.
+    """
+    t0 = time.perf_counter()
+    with span("ckpt.commit", epoch=int(epoch), step=int(step)):
+        deadline = time.monotonic() + timeout
+        own = write_epoch_shards(ckpt_dir, epoch, step, array)
+        if process_id != 0:
+            _wait_for_commit(ckpt_dir, epoch, deadline)
+            return None
+
+        edir = os.path.join(ckpt_dir, epoch_dirname(epoch))
+        entries = []
+        for index in global_shard_map(array):
+            fname = shard_filename(index)
+            # validate every file by read-back — own shards included, so a
+            # torn local write aborts the commit here, not poisons resume
+            crc = _validate_shard(os.path.join(edir, fname), step, deadline)
+            entries.append({"file": fname, "index": [list(r) for r in index],
+                            "crc": crc})
+        manifest = {
+            "format": _FORMAT,
+            "epoch": int(epoch),
+            "step": int(step),
+            "world": int(process_count),
+            "epoch_dir": epoch_dirname(epoch),
+            "global_shape": [int(d) for d in array.shape],
+            "true_shape": [int(d) for d in true_shape],
+            "dtype": str(array.dtype),
+            "meta": dict(meta or {}),
+            "shards": entries,
+        }
+        # the crash window under test: shards durable, manifest not yet live
+        maybe_fail_commit()
+        _publish(ckpt_dir, manifest)
+        ms = round((time.perf_counter() - t0) * 1e3, 3)
+        metrics.counter("commit.epochs").inc()
+        metrics.histogram("commit.ms").observe(ms)
+        record_event("epoch-commit", epoch=int(epoch), step=int(step),
+                     world=int(process_count), shards=len(entries), ms=ms)
+        _gc_epochs(ckpt_dir)
+        return manifest
 
 
 def _publish(ckpt_dir: str, manifest: dict) -> None:
@@ -294,10 +306,15 @@ def load_latest_commit(ckpt_dir: str):
             continue
         try:
             manifest = _load_manifest(path)
-            return manifest, _assemble(ckpt_dir, manifest)
+            restored = _assemble(ckpt_dir, manifest)
         except Exception as e:  # torn manifest/shard: fall back a generation
+            metrics.counter("commit.invalid").inc()
             record_event("commit-invalid", candidate=name,
                          error=type(e).__name__, message=str(e)[:200])
+            continue
+        record_event("commit-loaded", epoch=manifest["epoch"],
+                     step=manifest["step"], candidate=name)
+        return manifest, restored
     return None
 
 

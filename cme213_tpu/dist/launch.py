@@ -81,6 +81,16 @@ def _pump(rank: int, stream, out) -> None:
         out.flush()
 
 
+def _template_trace_file(env: dict, rank: int) -> None:
+    """Expand a ``{rank}`` placeholder in the worker's ``CME213_TRACE_FILE``
+    so gang members write per-rank sink files instead of interleaving into
+    one (the launcher's own events keep the un-expanded path, which
+    ``core/trace`` resolves to ``...main...`` for non-rank processes)."""
+    tf = env.get("CME213_TRACE_FILE")
+    if tf and "{rank}" in tf:
+        env["CME213_TRACE_FILE"] = tf.replace("{rank}", str(rank))
+
+
 def launch(np_procs: int, cmd: list[str], devices_per_proc: int | None = None,
            coordinator: str | None = None, timeout: float | None = None,
            handshake_timeout: float | None = None,
@@ -101,6 +111,7 @@ def launch(np_procs: int, cmd: list[str], devices_per_proc: int | None = None,
                    JAX_NUM_PROCESSES=str(np_procs),
                    JAX_PROCESS_ID=str(rank),
                    CME213_INCARNATION=str(incarnation))
+        _template_trace_file(env, rank)
         if handshake_timeout is not None:
             env["CME213_HANDSHAKE_TIMEOUT"] = str(handshake_timeout)
         if devices_per_proc:
@@ -204,6 +215,8 @@ def launch_supervised(np_procs: int, cmd: list[str],
         # fresh coordinator port per incarnation: the previous port may be
         # lingering in TIME_WAIT or held by a not-yet-reaped rank
         coordinator = f"127.0.0.1:{free_port()}"
+        record_event("gang-launch", incarnation=incarnation,
+                     world=np_procs, coordinator=coordinator)
         procs = {}
         for rank in range(np_procs):
             env = dict(os.environ,
@@ -211,6 +224,7 @@ def launch_supervised(np_procs: int, cmd: list[str],
                        JAX_NUM_PROCESSES=str(np_procs),
                        JAX_PROCESS_ID=str(rank),
                        CME213_INCARNATION=str(incarnation))
+            _template_trace_file(env, rank)
             env[HEARTBEAT_DIR_ENV] = hb_dir
             env[HEARTBEAT_INTERVAL_ENV] = str(heartbeat_interval)
             if ckpt_dir:
@@ -261,6 +275,7 @@ def launch_supervised(np_procs: int, cmd: list[str],
                                  "code": code}
                     break
             if condemned is None and all(c == 0 for c in exited.values()):
+                record_event("gang-exit", incarnation=incarnation, rc=0)
                 return 0
             if condemned is None:
                 for s in supervisor.stalled():
@@ -271,6 +286,8 @@ def launch_supervised(np_procs: int, cmd: list[str],
                 if deadline is not None and time.monotonic() > deadline:
                     print(f"[launcher] timeout after {timeout}s; killing "
                           f"the gang", flush=True)
+                    record_event("gang-exit", incarnation=incarnation,
+                                 rc=124)
                     return 124
                 time.sleep(poll_interval)
                 continue
@@ -288,6 +305,7 @@ def launch_supervised(np_procs: int, cmd: list[str],
             if incarnation >= max_restarts:
                 print(f"[launcher] gang restart budget exhausted "
                       f"({max_restarts}); failing", flush=True)
+                record_event("gang-exit", incarnation=incarnation, rc=rc)
                 return rc
             incarnation += 1
             record_event("gang-restart", incarnation=incarnation,

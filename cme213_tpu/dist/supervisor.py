@@ -73,6 +73,12 @@ class HeartbeatWriter:
         os.replace(tmp, self.path)
         self._last_step = step
         self._last_t = now
+        # mirror each *published* beat into the trace so `trace merge`
+        # can interleave rank progress with commits and gang verdicts
+        # (same throttle as the file write — never chattier than it)
+        from ..core.trace import record_event
+
+        record_event("heartbeat", rank=self.rank, step=int(step))
 
 
 def heartbeat_from_env() -> HeartbeatWriter | None:

@@ -71,6 +71,12 @@ def _spmv_scan(argv: list[str]) -> int:
     return spmv_scan.main(["spmv_scan", *argv])
 
 
+def _trace(argv: list[str]) -> int:
+    from . import trace_cli
+
+    return trace_cli.main(argv)
+
+
 WORKLOADS: dict[str, Workload] = {
     w.name: w
     for w in (
@@ -86,6 +92,11 @@ WORKLOADS: dict[str, Workload] = {
                  "TPU-resident sort path", _sorts),
         Workload("spmv_scan", "hw_final", "iterated gather·multiply + "
                  "segmented scan engine", _spmv_scan),
+        # not a reference workload: the offline analysis pass over the
+        # telemetry sinks every workload above writes (SURVEY §5's
+        # spreadsheet step, made a first-class tool)
+        Workload("trace", "telemetry", "summary | timeline | merge over "
+                 "CME213_TRACE_FILE JSON-lines traces", _trace),
     )
 }
 

@@ -240,7 +240,7 @@ def run_heat_resilient(u, iters: int, order: int, xcfl, ycfl,
     """
     import jax.numpy as jnp
 
-    from ..core import PhaseTimer, check_op, with_fallback
+    from ..core import PhaseTimer, check_op, span, with_fallback
     from .stencil import run_heat
 
     b = BORDER_FOR_ORDER[order]
@@ -251,10 +251,13 @@ def run_heat_resilient(u, iters: int, order: int, xcfl, ycfl,
 
     def timed(rung, runner):
         def thunk():
-            check_op(f"heat.{rung}", runner(jnp.array(u_host)))
-            with timer.phase(phase_label) as ph:
-                out = runner(jnp.array(u_host))
-                ph.block(out)
+            # compile vs run split per rung, like spmv_scan's dispatch
+            with span("heat.compile", kernel=rung):
+                check_op(f"heat.{rung}", runner(jnp.array(u_host)))
+            with span("heat.run", kernel=rung, size=gy, iters=iters):
+                with timer.phase(phase_label) as ph:
+                    out = runner(jnp.array(u_host))
+                    ph.block(out)
             return out
         return thunk
 

@@ -1,0 +1,320 @@
+"""Offline trace analysis — ``python -m cme213_tpu trace <cmd> files...``.
+
+The reference derived all of its metrics offline: timer lines grepped out
+of job logs into spreadsheets (SURVEY §5).  This CLI is that analysis
+pass over the structured form — the JSON-lines files ``core/trace.py``
+sinks (``CME213_TRACE_FILE``, one file per rank via ``{rank}``
+templating).  Three commands:
+
+- ``summary``  — per-phase/per-kernel span time, served-rung and demotion
+  counts, checkpoint-commit latency percentiles, fault/retry/rollback
+  tallies, gang verdicts.  ``--require a,b`` fails (exit 1) when a named
+  span never completed — the CI smoke gate.
+- ``timeline`` — one chronological line per event with relative
+  timestamps and rank labels (span-begin records are folded into their
+  span-end line; ``--all`` shows everything).
+- ``merge``    — interleave many per-rank files into one time-sorted
+  JSON-lines stream (stdout or ``--out``); ``--timeline`` renders the
+  merged gang view instead — launch, heartbeats, epoch commits, the
+  stall/exit verdict, restart, resume — which is how a 2-rank rankkill
+  faultcheck run is reconstructed after the fact.
+
+Any unparseable line is a hard error (exit 2): a trace that cannot be
+trusted end-to-end must fail the smoke gate, not be silently skipped.
+Records missing fields their :data:`~cme213_tpu.core.trace.EVENT_SCHEMA`
+entry requires are counted and reported (but don't fail the parse — old
+traces stay readable).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter, defaultdict
+
+from .core.trace import validate_record
+
+
+class TraceParseError(ValueError):
+    """A sink file line that is not a JSON event record."""
+
+
+#: tags every record carries; hidden from per-event detail rendering
+_BASE_FIELDS = {"event", "t", "pid", "rank", "incarnation", "_file"}
+
+
+def load_events(paths: list[str]) -> list[dict]:
+    """Parse + time-sort the records of one or many sink files.  Raises
+    TraceParseError on any malformed line (parse errors are fatal — see
+    module docstring)."""
+    events = []
+    for path in paths:
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError as e:
+                    raise TraceParseError(f"{path}:{lineno}: {e}") from e
+                if not isinstance(rec, dict) or "event" not in rec:
+                    raise TraceParseError(
+                        f"{path}:{lineno}: not an event record")
+                rec["_file"] = os.path.basename(path)
+                events.append(rec)
+    # stable sort: equal timestamps keep file order (begin before end)
+    events.sort(key=lambda r: r.get("t") or 0.0)
+    return events
+
+
+def _rank_label(rec: dict) -> str:
+    r = rec.get("rank")
+    return f"r{r}" if isinstance(r, int) else "main"
+
+
+def _percentiles(vals: list[float]) -> dict:
+    vals = sorted(vals)
+
+    def pct(q):
+        return vals[min(len(vals) - 1, max(0, round(q * (len(vals) - 1))))]
+
+    return {"p50": pct(0.50), "p90": pct(0.90), "p99": pct(0.99),
+            "max": vals[-1]}
+
+
+# ------------------------------------------------------------------ summary
+
+def summarize(events: list[dict], out=None) -> dict:
+    """Print the aggregate report; returns the aggregates (tests use the
+    dict, humans read the text)."""
+    w = (out or sys.stdout).write
+    ranks = sorted({_rank_label(e) for e in events})
+    incarnations = sorted({e.get("incarnation", 0) for e in events})
+    ts = [e["t"] for e in events if isinstance(e.get("t"), (int, float))]
+    span_s = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    w(f"{len(events)} events over {span_s:.3f}s, ranks: "
+      f"{', '.join(ranks) or '-'}, incarnations: "
+      f"{', '.join(str(i) for i in incarnations)}\n")
+
+    invalid = Counter()
+    for e in events:
+        for missing in validate_record(e):
+            invalid[(e["event"], missing)] += 1
+    if invalid:
+        w("schema violations:\n")
+        for (ev, field), n in sorted(invalid.items()):
+            w(f"  {ev}: missing {field} x{n}\n")
+
+    # spans: per-phase/per-kernel time (the reference's timer table)
+    by_span = defaultdict(list)
+    begun = {}
+    for e in events:
+        if e["event"] == "span-begin":
+            begun[e.get("id")] = e
+        elif e["event"] == "span-end":
+            begun.pop(e.get("id"), None)
+            if isinstance(e.get("ms"), (int, float)):
+                by_span[e["span"]].append(e["ms"])
+    if by_span:
+        w("spans (ms):\n")
+        w(f"  {'name':<38} {'count':>5} {'total':>10} {'mean':>9} "
+          f"{'max':>9}\n")
+        for name in sorted(by_span):
+            vals = by_span[name]
+            w(f"  {name:<38} {len(vals):>5} {sum(vals):>10.2f} "
+              f"{sum(vals) / len(vals):>9.2f} {max(vals):>9.2f}\n")
+    if begun:
+        w(f"open spans (begun, never ended — killed mid-flight?): "
+          f"{', '.join(sorted(b['span'] for b in begun.values()))}\n")
+
+    served = Counter((e["op"], e["rung"]) for e in events
+                     if e["event"] == "served")
+    demoted_serves = sum(1 for e in events
+                         if e["event"] == "served" and e.get("demoted"))
+    if served:
+        w("served rungs:\n")
+        for (op, rung), n in sorted(served.items()):
+            w(f"  {op}: {rung} x{n}\n")
+        w(f"  (demoted serves: {demoted_serves})\n")
+    rung_failed = Counter((e["op"], e["rung"]) for e in events
+                          if e["event"] == "rung-failed")
+    if rung_failed:
+        w("demotions (rung-failed):\n")
+        for (op, rung), n in sorted(rung_failed.items()):
+            w(f"  {op}.{rung} x{n}\n")
+
+    commits = [e for e in events if e["event"] == "epoch-commit"]
+    commit_stats = None
+    if commits:
+        last = max(commits, key=lambda e: e.get("epoch", 0))
+        line = (f"epoch commits: {len(commits)} "
+                f"(latest epoch {last.get('epoch')}, step {last.get('step')})")
+        ms = [e["ms"] for e in commits if isinstance(e.get("ms"), (int, float))]
+        if ms:
+            commit_stats = _percentiles(ms)
+            line += ("  latency ms: " + " ".join(
+                f"{k}={v:.2f}" for k, v in commit_stats.items()))
+        w(line + "\n")
+    loads = [e for e in events if e["event"] == "commit-loaded"]
+    for e in loads:
+        w(f"resume: epoch {e.get('epoch')}, step {e.get('step')} "
+          f"from {e.get('candidate')} ({_rank_label(e)}, "
+          f"incarnation {e.get('incarnation')})\n")
+    bad = Counter(e.get("candidate") for e in events
+                  if e["event"] == "commit-invalid")
+    if bad:
+        w("invalid commits skipped: "
+          + ", ".join(f"{c} x{n}" for c, n in sorted(bad.items())) + "\n")
+
+    verdicts = [e for e in events if e["event"] == "rank-failed"]
+    restarts = [e for e in events if e["event"] == "gang-restart"]
+    launches = [e for e in events if e["event"] == "gang-launch"]
+    exits = [e for e in events if e["event"] == "gang-exit"]
+    if launches or verdicts or restarts:
+        w(f"gang: {len(launches)} launch(es), {len(verdicts)} verdict(s) "
+          f"[{', '.join(v.get('reason', '?') for v in verdicts) or '-'}], "
+          f"{len(restarts)} restart(s)"
+          + (f", final rc {exits[-1].get('rc')}" if exits else "") + "\n")
+    beats = defaultdict(list)
+    for e in events:
+        if e["event"] == "heartbeat":
+            beats[e.get("rank")].append(e.get("step"))
+    for rank in sorted(beats, key=str):
+        w(f"heartbeats r{rank}: {len(beats[rank])} "
+          f"(last step {beats[rank][-1]})\n")
+
+    counts = Counter(e["event"] for e in events)
+    for label, ev in (("op failures", "op-failure"),
+                      ("retries", "retry"),
+                      ("numeric aborts", "numeric-abort"),
+                      ("checkpoint rollbacks", "checkpoint-rollback"),
+                      ("checkpoint quarantines", "checkpoint-quarantine")):
+        if counts[ev]:
+            w(f"{label}: {counts[ev]}\n")
+    faults = Counter(e.get("kind") for e in events
+                     if e["event"] == "fault-injected")
+    if faults:
+        w("faults injected: "
+          + ", ".join(f"{k} x{n}" for k, n in sorted(faults.items())) + "\n")
+
+    return {"events": len(events), "ranks": ranks, "spans": dict(by_span),
+            "served": dict(served), "rung_failed": dict(rung_failed),
+            "commits": len(commits), "commit_ms": commit_stats,
+            "resumes": len(loads), "verdicts": len(verdicts),
+            "restarts": len(restarts), "invalid": dict(invalid),
+            "counts": dict(counts)}
+
+
+# ----------------------------------------------------------------- timeline
+
+def _detail(rec: dict) -> str:
+    ev = rec["event"]
+    if ev in ("span-begin", "span-end"):
+        parts = [str(rec.get("span", "?"))]
+        if "ms" in rec:
+            parts.append(f"ms={rec['ms']}")
+        if "error" in rec:
+            parts.append(f"error={rec['error']}")
+        parts += [f"{k}={rec[k]}" for k in sorted(rec)
+                  if k not in _BASE_FIELDS
+                  and k not in ("span", "id", "parent", "ms", "error")]
+        return " ".join(parts)
+    if ev == "metrics-snapshot":
+        m = rec.get("metrics", {})
+        return (f"{len(m.get('counters', {}))} counters, "
+                f"{len(m.get('gauges', {}))} gauges, "
+                f"{len(m.get('histograms', {}))} histograms")
+    parts = []
+    for k in sorted(rec):
+        if k in _BASE_FIELDS:
+            continue
+        v = rec[k]
+        if isinstance(v, str) and len(v) > 60:
+            v = v[:57] + "..."
+        parts.append(f"{k}={v}")
+    return " ".join(parts)
+
+
+def render_timeline(events: list[dict], out=None,
+                    show_all: bool = False) -> None:
+    """One line per event, chronological, relative to the first record —
+    the merged gang view when fed every rank's file."""
+    out = out or sys.stdout
+    ts = [e["t"] for e in events if isinstance(e.get("t"), (int, float))]
+    t0 = min(ts) if ts else 0.0
+    for e in events:
+        if not show_all and e["event"] == "span-begin":
+            continue  # folded into the span-end line (which carries ms)
+        t = e.get("t")
+        rel = f"+{t - t0:9.3f}s" if isinstance(t, (int, float)) else " " * 11
+        inc = e.get("incarnation", 0)
+        out.write(f"{rel} {_rank_label(e):>5} i{inc} "
+                  f"{e['event']:<22} {_detail(e)}\n")
+
+
+# -------------------------------------------------------------------- main
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cme213_tpu trace",
+        description="analyze CME213_TRACE_FILE JSON-lines traces")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_sum = sub.add_parser("summary", help="aggregate report over traces")
+    p_sum.add_argument("files", nargs="+")
+    p_sum.add_argument("--require", default="",
+                       help="comma-separated span names that must have "
+                            "completed (exit 1 otherwise — the CI gate)")
+
+    p_tl = sub.add_parser("timeline", help="chronological event listing")
+    p_tl.add_argument("files", nargs="+")
+    p_tl.add_argument("--all", action="store_true",
+                      help="include span-begin records")
+
+    p_mg = sub.add_parser("merge", help="interleave per-rank files")
+    p_mg.add_argument("files", nargs="+")
+    p_mg.add_argument("--timeline", action="store_true",
+                      help="render the merged gang timeline instead of "
+                           "JSON lines")
+    p_mg.add_argument("--out", default=None,
+                      help="write merged JSON lines here (default stdout)")
+
+    args = ap.parse_args(argv)
+    try:
+        events = load_events(args.files)
+    except (TraceParseError, OSError) as e:
+        print(f"trace: {e}", file=sys.stderr)
+        return 2
+
+    if args.cmd == "summary":
+        agg = summarize(events)
+        required = [s.strip() for s in args.require.split(",") if s.strip()]
+        missing = [s for s in required if s not in agg["spans"]]
+        if missing:
+            print(f"trace: required span(s) never completed: "
+                  f"{', '.join(missing)}", file=sys.stderr)
+            return 1
+        return 0
+    if args.cmd == "timeline":
+        render_timeline(events, show_all=args.all)
+        return 0
+    # merge
+    if args.timeline:
+        render_timeline(events)
+        return 0
+    out = open(args.out, "w") if args.out else sys.stdout
+    try:
+        for e in events:
+            rec = {k: v for k, v in e.items() if k != "_file"}
+            out.write(json.dumps(rec, default=str) + "\n")
+    finally:
+        if args.out:
+            out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

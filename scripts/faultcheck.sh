@@ -22,13 +22,32 @@
 #     exchange riding cross-process collectives — auto-SKIPPED (not
 #     failed) where this jaxlib can't do multiprocess CPU, using the
 #     same capability probe as tests/test_multihost.py.
+# And per ISSUE 4 (telemetry): the gang runs of steps 4/5 sink per-rank
+# trace files (CME213_TRACE_FILE={rank}-templated), and
+#  6. `trace summary`/`timeline`/`merge --timeline` over those files must
+#     parse cleanly and contain the required commit spans + the full
+#     recovery arc (rankkill -> verdict -> restart -> resume).
+# On ANY failing step the merged gang timeline is printed for
+# debuggability before the workspace is cleaned up.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 OUT=$(mktemp -d)
-trap 'rm -rf "$OUT"' EXIT
+on_exit() {
+  rc=$?
+  # a failing step leaves with rc != 0 (set -e): print the merged gang
+  # timeline for debuggability before the workspace goes away.  (EXIT,
+  # not ERR: steps probing expected failures under `set +e` must not
+  # trigger it.)
+  if [ "$rc" -ne 0 ] && ls "$OUT"/trace*.jsonl >/dev/null 2>&1; then
+    echo "== faultcheck FAILED (rc=$rc); merged gang trace timeline:" >&2
+    python -m cme213_tpu trace merge --timeline "$OUT"/trace*.jsonl >&2 || true
+  fi
+  rm -rf "$OUT"
+}
+trap on_exit EXIT
 
-echo "== 1/5 run_all: injected sweep failure -> retry + failures.json"
+echo "== 1/6 run_all: injected sweep failure -> retry + failures.json"
 CME213_FAULTS="fail:sweep.scan_bandwidth" \
     python -m cme213_tpu.bench.run_all --quick --out "$OUT" \
     --only scan_bandwidth
@@ -40,7 +59,7 @@ assert [r["sweep"] for r in m["retried"]] == ["scan_bandwidth"], m
 print("failures.json populated:", m["retried"][0]["error"])
 PY
 
-echo "== 2/5 spmv ladder: injected pallas failure -> demoted, correct"
+echo "== 2/6 spmv ladder: injected pallas failure -> demoted, correct"
 CME213_FAULTS="fail:spmv_scan.pallas-fused" python - <<'PY'
 from cme213_tpu.apps import spmv_scan as sp
 from cme213_tpu.core import trace
@@ -53,7 +72,7 @@ assert errs["rel_l2"] < 1e-4, errs
 print("demoted to", served["rung"], "rel_l2", errs["rel_l2"])
 PY
 
-echo "== 3/5 launcher: injected rank kill survived by --max-restarts 1"
+echo "== 3/6 launcher: injected rank kill survived by --max-restarts 1"
 CME213_FAULTS="rankkill:1:0" python -m cme213_tpu.dist.launch \
     --np 2 --max-restarts 1 --timeout 120 -- \
     python -c "import os; from cme213_tpu.core import faults; \
@@ -78,10 +97,12 @@ cat > "$OUT/params_gang.in" <<'EOF'
 100.0 25.0 0.0 50.0
 EOF
 
-echo "== 4/5 supervised gang: rankkill -> gang restart + epoch-commit resume"
+echo "== 4/6 supervised gang: rankkill -> gang restart + epoch-commit resume"
 # 1 process x 2 fake devices: real halo-exchange collectives in the rank,
-# real process death, real gang supervision — works on every backend
-CME213_FAULTS="rankkill:0:1" JAX_PLATFORMS= python -m cme213_tpu.dist.launch \
+# real process death, real gang supervision — works on every backend.
+# Per-rank trace sinks feed step 6's CLI gate.
+CME213_FAULTS="rankkill:0:1" JAX_PLATFORMS= \
+CME213_TRACE_FILE="$OUT/trace4-{rank}.jsonl" python -m cme213_tpu.dist.launch \
     --np 1 --devices-per-proc 2 --stall-timeout 120 --max-restarts 1 \
     --ckpt-dir "$OUT/gang1" --ckpt-every 2 --timeout 300 -- \
     python -m cme213_tpu.apps.heat2d "$OUT/params_gang.in" --supervised \
@@ -98,9 +119,10 @@ print(f"gang recovery OK (final commit: epoch {m['epoch']}, "
       f"step {m['step']})")
 PY
 
-echo "== 5/5 supervised gang across 2 REAL ranks (capability-gated)"
+echo "== 5/6 supervised gang across 2 REAL ranks (capability-gated)"
 set +e
-CME213_FAULTS="rankkill:1:1" JAX_PLATFORMS= python -m cme213_tpu.dist.launch \
+CME213_FAULTS="rankkill:1:1" JAX_PLATFORMS= \
+CME213_TRACE_FILE="$OUT/trace5-{rank}.jsonl" python -m cme213_tpu.dist.launch \
     --np 2 --devices-per-proc 1 --stall-timeout 120 --max-restarts 1 \
     --ckpt-dir "$OUT/gang2" --ckpt-every 2 --timeout 300 -- \
     python -m cme213_tpu.apps.heat2d "$OUT/params_gang.in" --supervised \
@@ -123,6 +145,27 @@ else
   grep -q "gang restart (incarnation 1/1)" "$OUT/gang2.log"
   grep -q "supervised solve complete" "$OUT/gang2.log"
   echo "2-rank gang recovery OK"
+fi
+
+echo "== 6/6 trace CLI over the per-rank gang traces (ISSUE 4)"
+# step 4's files always exist; any unparseable line exits 2, a missing
+# commit span or gang phase exits 1 — either fails the gate
+python -m cme213_tpu trace summary "$OUT"/trace4-*.jsonl \
+    --require "ckpt.commit,supervised distributed computation"
+python -m cme213_tpu trace timeline "$OUT"/trace4-*.jsonl > /dev/null
+python -m cme213_tpu trace merge --timeline "$OUT"/trace4-*.jsonl \
+    > "$OUT/timeline4.txt"
+# the reconstructed recovery arc: kill -> verdict -> restart -> resume
+for marker in "fault-injected" "rank-failed" "gang-restart" \
+              "commit-loaded" "gang-exit"; do
+  grep -q "$marker" "$OUT/timeline4.txt"
+done
+echo "gang timeline reconstructed ($(wc -l < "$OUT/timeline4.txt") events)"
+if ls "$OUT"/trace5-*.jsonl >/dev/null 2>&1; then
+  # the 2-real-rank run (step 5) also left traces — merge must interleave
+  # them even when the run itself was capability-skipped mid-flight
+  python -m cme213_tpu trace merge --timeline "$OUT"/trace5-*.jsonl \
+      > /dev/null
 fi
 
 echo "faultcheck OK"

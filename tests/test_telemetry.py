@@ -1,0 +1,434 @@
+"""Telemetry subsystem: spans, metrics registry, hardened sinks, event
+schema, and the ``trace`` analysis CLI (ISSUE 4).
+
+The schema test is the load-bearing one: it statically checks every
+``record_event`` call site in the package against
+``core/trace.EVENT_SCHEMA``, so a new event (or a renamed field) must be
+registered before it can ship — the documented schema IS the wire format
+``trace merge`` reconstructs gang timelines from.
+"""
+
+import ast
+import json
+import os
+import pathlib
+
+import pytest
+
+import cme213_tpu
+from cme213_tpu.core import metrics, trace
+from cme213_tpu.core.timing import PhaseTimer
+from cme213_tpu.core.trace import EVENT_SCHEMA, span, validate_record
+from cme213_tpu import trace_cli
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    trace.flush_sink()
+    trace.clear_events()
+    yield
+    trace.flush_sink()
+    trace.clear_events()
+
+
+# ------------------------------------------------------------------ spans
+
+def test_span_nesting_parent_links_and_tags():
+    with span("outer", kind="test"):
+        with span("inner"):
+            pass
+    ev = trace.events()
+    assert [e["event"] for e in ev] == [
+        "span-begin", "span-begin", "span-end", "span-end"]
+    outer_b, inner_b, inner_e, outer_e = ev
+    assert outer_b["parent"] is None
+    assert inner_b["parent"] == outer_b["id"]
+    assert inner_e["id"] == inner_b["id"]
+    assert outer_e["kind"] == "test" and outer_e["ms"] >= inner_e["ms"] >= 0
+
+
+def test_span_ids_unique_and_stack_restored():
+    ids = set()
+    for _ in range(5):
+        with span("s"):
+            pass
+    for e in trace.events("span-begin"):
+        ids.add(e["id"])
+    assert len(ids) == 5
+    assert trace.current_span_id() is None
+
+
+def test_span_error_tagged_and_reraised():
+    with pytest.raises(ValueError):
+        with span("boom"):
+            raise ValueError("x")
+    end = trace.events("span-end")[-1]
+    assert end["error"] == "ValueError" and end["ms"] >= 0
+
+
+def test_span_blocks_device_work():
+    import jax.numpy as jnp
+
+    with span("device") as sp:
+        out = jnp.ones(128) * 2
+        sp.block(out)
+    assert trace.events("span-end")[-1]["ms"] >= 0
+
+
+def test_span_durations_feed_metrics():
+    metrics.reset()
+    with span("timed"):
+        pass
+    snap = metrics.snapshot()
+    assert snap["histograms"]["span.timed.ms"]["count"] == 1
+
+
+def test_every_record_carries_process_tags(monkeypatch):
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    monkeypatch.setenv("CME213_INCARNATION", "1")
+    rec = trace.record_event("heartbeat", rank=2, step=7)
+    assert rec["pid"] == os.getpid()
+    assert rec["rank"] == 2 and rec["incarnation"] == 1
+    monkeypatch.delenv("JAX_PROCESS_ID")
+    assert trace.record_event("heartbeat", rank=0, step=8)["rank"] == 0
+    # auto tag is None for non-rank processes (explicit field wins above)
+    assert trace.record_event("gang-exit", incarnation=0, rc=0)["rank"] is None
+
+
+def test_phase_timer_emits_spans():
+    t = PhaseTimer()
+    with t.phase("phase-x") as ph:
+        ph.block()  # no arrays: host-only phase
+    assert t.ms("phase-x") >= 0
+    ends = trace.events("span-end")
+    assert [e["span"] for e in ends] == ["phase-x"]
+    assert abs(ends[0]["ms"] - t.ms("phase-x")) < 50
+
+
+# ------------------------------------------------------------------ buffer
+
+def test_ring_buffer_cap(monkeypatch):
+    monkeypatch.setenv(trace.TRACE_BUFFER_ENV, "4")
+    trace.clear_events()  # re-reads the cap
+    for i in range(10):
+        trace.record_event("heartbeat", rank=0, step=i)
+    ev = trace.events("heartbeat")
+    assert len(ev) == 4 and [e["step"] for e in ev] == [6, 7, 8, 9]
+
+
+def test_buffer_default_unbounded():
+    for i in range(300):
+        trace.record_event("heartbeat", rank=0, step=i)
+    assert len(trace.events("heartbeat")) == 300
+
+
+# ------------------------------------------------------------------- sinks
+
+def test_sink_appends_jsonl_with_cached_handle(tmp_path, monkeypatch):
+    path = tmp_path / "t.jsonl"
+    monkeypatch.setenv(trace.TRACE_FILE_ENV, str(path))
+    for i in range(3):
+        trace.record_event("heartbeat", rank=0, step=i)
+    trace.flush_sink()
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [r["step"] for r in recs] == [0, 1, 2]
+    assert all(not validate_record(r) for r in recs)
+    # handle survives flush (reopened lazily) and keeps appending
+    trace.record_event("heartbeat", rank=0, step=3)
+    trace.flush_sink()
+    assert len(path.read_text().splitlines()) == 4
+
+
+def test_sink_rank_templating(tmp_path, monkeypatch):
+    monkeypatch.setenv(trace.TRACE_FILE_ENV, str(tmp_path / "t-{rank}.jsonl"))
+    monkeypatch.setenv("JAX_PROCESS_ID", "5")
+    trace.record_event("heartbeat", rank=5, step=1)
+    trace.flush_sink()
+    assert (tmp_path / "t-5.jsonl").exists()
+    monkeypatch.delenv("JAX_PROCESS_ID")
+    trace.record_event("gang-launch", incarnation=0, world=2,
+                       coordinator="x")
+    trace.flush_sink()
+    assert (tmp_path / "t-main.jsonl").exists()
+
+
+def test_sink_broken_path_never_raises(monkeypatch):
+    monkeypatch.setenv(trace.TRACE_FILE_ENV,
+                       "/nonexistent-dir-xyz/t.jsonl")
+    rec = trace.record_event("heartbeat", rank=0, step=1)  # must not raise
+    assert rec["step"] == 1
+
+
+def test_launcher_templates_trace_file_per_worker():
+    from cme213_tpu.dist.launch import _template_trace_file
+
+    env = {"CME213_TRACE_FILE": "/tmp/x/t-{rank}.jsonl"}
+    _template_trace_file(env, 3)
+    assert env["CME213_TRACE_FILE"] == "/tmp/x/t-3.jsonl"
+    env2 = {"CME213_TRACE_FILE": "/tmp/x/flat.jsonl"}
+    _template_trace_file(env2, 3)  # no placeholder: untouched
+    assert env2["CME213_TRACE_FILE"] == "/tmp/x/flat.jsonl"
+    _template_trace_file({}, 0)  # no sink configured: no-op
+
+
+# ----------------------------------------------------------------- metrics
+
+def test_metrics_counter_gauge_histogram():
+    metrics.reset()
+    metrics.counter("c").inc()
+    metrics.counter("c").inc(4)
+    metrics.gauge("g").set(13)
+    for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+        metrics.histogram("h").observe(v)
+    snap = metrics.snapshot()
+    assert snap["counters"]["c"] == 5
+    assert snap["gauges"]["g"] == 13
+    h = snap["histograms"]["h"]
+    assert h["count"] == 5 and h["min"] == 1.0 and h["max"] == 100.0
+    assert h["p50"] == 3.0 and h["sum"] == 110.0
+    assert metrics.histogram("h").percentile(0.0) == 1.0
+
+
+def test_metrics_delta():
+    metrics.reset()
+    metrics.counter("a").inc(2)
+    metrics.histogram("h").observe(1.0)
+    before = metrics.snapshot()
+    metrics.counter("a").inc(3)
+    metrics.counter("b").inc()
+    metrics.histogram("h").observe(2.0)
+    d = metrics.delta(before, metrics.snapshot())
+    assert d["counters"] == {"a": 3, "b": 1}
+    assert d["histograms"]["h"]["count_delta"] == 1
+
+
+def test_histogram_ring_is_bounded():
+    metrics.reset()
+    h = metrics.histogram("big")
+    for i in range(metrics.KEEP + 100):
+        h.observe(float(i))
+    assert h.count == metrics.KEEP + 100
+    assert len(h._recent) == metrics.KEEP
+
+
+def test_fallback_ladder_updates_metrics():
+    from cme213_tpu.core.faults import injected
+    from cme213_tpu.core.resilience import with_fallback
+
+    metrics.reset()
+    with injected("fail:op.a"):
+        res = with_fallback("op", [("a", lambda: 1), ("b", lambda: 2)])
+    assert res.rung == "b"
+    snap = metrics.snapshot()
+    assert snap["counters"]["fallback.demotions"] == 1
+    assert snap["counters"]["served.op.b"] == 1
+    assert snap["counters"]["faults.fail"] == 1
+
+
+# ------------------------------------------------------------------ schema
+
+def _record_event_calls():
+    pkg_dir = pathlib.Path(cme213_tpu.__file__).parent
+    for py in sorted(pkg_dir.rglob("*.py")):
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name != "record_event":
+                continue
+            yield py.relative_to(pkg_dir), node
+
+
+def test_every_call_site_uses_a_registered_event():
+    sites = 0
+    for src, node in _record_event_calls():
+        assert node.args and isinstance(node.args[0], ast.Constant), (
+            f"{src}:{node.lineno}: record_event must be called with a "
+            f"literal event name")
+        event = node.args[0].value
+        assert event in EVENT_SCHEMA, (
+            f"{src}:{node.lineno}: event {event!r} not in EVENT_SCHEMA — "
+            f"register its required fields in core/trace.py")
+        sites += 1
+    assert sites >= 15  # the wiring exists (spans + 4 layers)
+
+
+def test_call_sites_emit_their_documented_fields():
+    auto = {"pid", "rank", "incarnation"}
+    for src, node in _record_event_calls():
+        event = node.args[0].value
+        kw = [k.arg for k in node.keywords]
+        if None in kw:  # **expansion: covered by the runtime check below
+            continue
+        missing = set(EVENT_SCHEMA[event]) - set(kw) - auto
+        assert not missing, (
+            f"{src}:{node.lineno}: {event!r} missing documented "
+            f"field(s) {sorted(missing)}")
+
+
+def test_runtime_records_validate_against_schema():
+    """Dynamic call sites (**kwargs) checked by actually driving them."""
+    from cme213_tpu.core.faults import injected
+    from cme213_tpu.core.resilience import RetryPolicy, with_fallback
+
+    with injected("fail:rt.a"):
+        with_fallback("rt", [("a", lambda: 1), ("b", lambda: 2)],
+                      policy=RetryPolicy(max_retries=0))
+    with span("s", kernel="k"):
+        pass
+    for rec in trace.events():
+        assert validate_record(rec) == [], rec
+
+
+def test_validate_record_reports_missing():
+    assert validate_record({"event": "served", "op": "x"}) == [
+        "rung", "demoted", "failed_rungs"]
+    assert validate_record({"event": "unknown-event"}) == []
+
+
+# --------------------------------------------------------------------- CLI
+
+def _write_trace(path, recs):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def _gang_fixture(tmp_path):
+    """Synthetic 2-rank + launcher trace triple shaped like a rankkill
+    faultcheck run."""
+    base = {"pid": 1, "incarnation": 0}
+    launcher = [
+        {"event": "gang-launch", "t": 0.0, "rank": None, "incarnation": 0,
+         "world": 2, "coordinator": "127.0.0.1:1", "pid": 9},
+        {"event": "rank-failed", "t": 3.0, "rank": 1, "incarnation": 0,
+         "reason": "exit", "code": 113, "pid": 9},
+        {"event": "gang-restart", "t": 3.1, "rank": None, "incarnation": 1,
+         "reason": "exit", "pid": 9},
+        {"event": "gang-launch", "t": 3.2, "rank": None, "incarnation": 1,
+         "world": 2, "coordinator": "127.0.0.1:2", "pid": 9},
+        {"event": "gang-exit", "t": 9.0, "rank": None, "incarnation": 1,
+         "rc": 0, "pid": 9},
+    ]
+    r0 = [
+        {"event": "heartbeat", "t": 1.0, "rank": 0, "step": 0, **base},
+        {"event": "epoch-commit", "t": 2.0, "rank": 0, "epoch": 1,
+         "step": 2, "world": 2, "shards": 2, "ms": 5.0, **base},
+        {"event": "epoch-commit", "t": 2.5, "rank": 0, "epoch": 2,
+         "step": 4, "world": 2, "shards": 2, "ms": 7.0, **base},
+        {"event": "commit-loaded", "t": 4.0, "rank": 0, "epoch": 2,
+         "step": 4, "candidate": "COMMIT", "pid": 2, "incarnation": 1},
+        {"event": "epoch-commit", "t": 5.0, "rank": 0, "epoch": 3,
+         "step": 8, "world": 2, "shards": 2, "ms": 6.0, "pid": 2,
+         "incarnation": 1},
+        {"event": "span-begin", "t": 0.5, "rank": 0, "span": "solve",
+         "id": "a.1", "parent": None, **base},
+        {"event": "span-end", "t": 6.0, "rank": 0, "span": "solve",
+         "id": "a.1", "parent": None, "ms": 5500.0, "pid": 2,
+         "incarnation": 1},
+    ]
+    r1 = [
+        {"event": "heartbeat", "t": 1.1, "rank": 1, "step": 0, **base},
+        {"event": "fault-injected", "t": 2.9, "rank": 1, "kind": "rankkill",
+         "op": "1", "step": 1, **base},
+    ]
+    paths = []
+    for name, recs in (("trace-main.jsonl", launcher),
+                       ("trace-0.jsonl", r0), ("trace-1.jsonl", r1)):
+        p = tmp_path / name
+        _write_trace(p, recs)
+        paths.append(str(p))
+    return paths
+
+
+def test_cli_summary_reconstructs_gang_view(tmp_path, capsys):
+    paths = _gang_fixture(tmp_path)
+    assert trace_cli.main(["summary", *paths]) == 0
+    out = capsys.readouterr().out
+    assert "ranks: main, r0, r1" in out
+    assert "epoch commits: 3" in out and "p50=6.00" in out
+    assert "resume: epoch 2, step 4 from COMMIT" in out
+    assert "gang: 2 launch(es), 1 verdict(s) [exit], 1 restart(s), " \
+           "final rc 0" in out
+    assert "rankkill x1" in out
+
+
+def test_cli_summary_require_missing_span(tmp_path, capsys):
+    paths = _gang_fixture(tmp_path)
+    assert trace_cli.main(["summary", *paths, "--require", "solve"]) == 0
+    assert trace_cli.main(
+        ["summary", *paths, "--require", "solve,absent-span"]) == 1
+    assert "absent-span" in capsys.readouterr().err
+
+
+def test_cli_timeline_orders_ranks_chronologically(tmp_path, capsys):
+    paths = _gang_fixture(tmp_path)
+    assert trace_cli.main(["merge", "--timeline", *paths]) == 0
+    out = capsys.readouterr().out
+    lines = out.splitlines()
+    order = [line.split()[2] for line in lines]  # rank labels
+    assert order[0] == "main"  # gang-launch first
+    # the verdict chain appears in causal order across files
+    joined = "\n".join(lines)
+    assert joined.index("fault-injected") < joined.index("rank-failed") \
+        < joined.index("gang-restart") < joined.index("commit-loaded") \
+        < joined.index("gang-exit")
+    # span-begin folded away; span-end visible with its duration
+    assert "span-begin" not in joined and "solve ms=5500.0" in joined
+
+
+def test_cli_merge_emits_sorted_jsonl(tmp_path, capsys):
+    paths = _gang_fixture(tmp_path)
+    out_path = tmp_path / "merged.jsonl"
+    assert trace_cli.main(["merge", *paths, "--out", str(out_path)]) == 0
+    recs = [json.loads(line)
+            for line in out_path.read_text().splitlines()]
+    assert len(recs) == 14
+    assert [r["t"] for r in recs] == sorted(r["t"] for r in recs)
+    assert all("_file" not in r for r in recs)
+
+
+def test_cli_parse_error_is_fatal(tmp_path, capsys):
+    p = tmp_path / "bad.jsonl"
+    p.write_text('{"event": "heartbeat", "t": 1.0}\nnot json\n')
+    assert trace_cli.main(["summary", str(p)]) == 2
+    assert "bad.jsonl:2" in capsys.readouterr().err
+
+
+def test_cli_summary_counts_schema_violations(tmp_path, capsys):
+    p = tmp_path / "t.jsonl"
+    _write_trace(p, [{"event": "served", "t": 1.0, "op": "x", "rung": "a",
+                      "demoted": False}])
+    assert trace_cli.main(["summary", str(p)]) == 0
+    assert "served: missing failed_rungs x1" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------- integration
+
+def test_spmv_demotion_flows_to_trace_file(tmp_path, monkeypatch, capsys):
+    """End-to-end: fault-injected dispatch -> per-process sink -> CLI."""
+    from cme213_tpu.apps import spmv_scan as sp
+    from cme213_tpu.core.faults import injected
+
+    path = tmp_path / "t.jsonl"
+    monkeypatch.setenv(trace.TRACE_FILE_ENV, str(path))
+    prob = sp.generate_problem(512, 8, 7, iters=3, seed=0)
+    with injected("fail:spmv_scan.pallas-fused"):
+        sp.run_spmv_scan(prob, kernel="pallas-fused")
+    trace.flush_sink()
+    monkeypatch.delenv(trace.TRACE_FILE_ENV)
+    capsys.readouterr()
+    assert trace_cli.main(
+        ["summary", str(path),
+         "--require", "spmv_scan.compile,spmv_scan.run"]) == 0
+    out = capsys.readouterr().out
+    assert "spmv_scan: blocked x1" in out
+    assert "spmv_scan.pallas-fused x1" in out
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    assert all(validate_record(r) == [] for r in recs)
+    run_end = [r for r in recs if r["event"] == "span-end"
+               and r["span"] == "spmv_scan.run"]
+    assert run_end and run_end[0]["kernel"] == "blocked"
