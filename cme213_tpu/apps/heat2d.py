@@ -123,11 +123,26 @@ def run_heat_checkpointed(params: SimParams, path: str, every: int = 0,
     to the last good checksummed checkpoint and retries the chunk; a
     killed process resumes from ``path``.  Deterministic chunking makes an
     interrupted-and-resumed solve bitwise equal to an uninterrupted one.
+
+    Memory pressure degrades instead of dying: the chunk program is
+    preflighted against the memory budget (``core/admission.preflight``;
+    a grid the budget can never hold is refused up front), and a chunk
+    that dies ``RESOURCE_EXHAUSTED`` at runtime (real, or
+    ``CME213_FAULTS=oom:heat_chunk``) is halved and retried from the
+    last checkpoint — bitwise-neutral, every iteration runs the same
+    stencil whatever the chunk boundaries.
     """
+    from ..core import admission
     from ..core.checkpoint import run_with_checkpoints
     from ..core.resilience import all_finite
 
     u0 = make_initial_grid(params, dtype=jnp.float32)
+    every_eff = every or params.iters
+    decision = admission.preflight(
+        run_heat, jnp.zeros_like(u0), min(every_eff, params.iters),
+        params.order, params.xcfl, params.ycfl, op="heat2d")
+    if not decision.admitted:
+        raise admission.AdmissionError(f"heat2d: {decision.detail}")
 
     def step(state, k):
         return {"grid": run_heat(jnp.asarray(state["grid"]), k,
@@ -135,7 +150,8 @@ def run_heat_checkpointed(params: SimParams, path: str, every: int = 0,
 
     out = run_with_checkpoints(step, {"grid": u0}, params.iters, path,
                                every=every, guard=all_finite, op="heat2d",
-                               max_retries=max_retries)
+                               max_retries=max_retries,
+                               chunk_op="heat_chunk")
     return np.asarray(out["grid"])
 
 

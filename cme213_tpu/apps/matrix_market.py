@@ -12,6 +12,7 @@ fed to the engine when available.
 from __future__ import annotations
 
 import gzip
+import warnings
 
 import numpy as np
 
@@ -65,7 +66,12 @@ def read_matrix_market(path: str):
             raise data_error(path, "size-line",
                              f"non-positive dims/count ({nr}, {nc}, {nnz})")
         try:
-            data = np.loadtxt(f, ndmin=2)
+            with warnings.catch_warnings():
+                # a file truncated to zero entries triggers np.loadtxt's
+                # empty-input UserWarning; that case is data, not noise —
+                # it flows into the entry-count DataValidationError below
+                warnings.simplefilter("ignore", UserWarning)
+                data = np.loadtxt(f, ndmin=2)
         except ValueError as e:
             raise data_error(path, "entries",
                              f"unparseable entry data: {e}") from e

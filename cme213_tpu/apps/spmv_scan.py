@@ -229,6 +229,67 @@ FALLBACK_LADDERS = {
     "flat": ("flat",),
 }
 
+#: conformance tolerance per rung, vs the ``flat`` reference scan (probe
+#: rel-L2).  ``flat`` is the reference itself; every other kernel
+#: legitimately reorders the segment accumulation (blocked 3-phase
+#: decomposition, Pallas blockwise carries, dense per-segment rows), so
+#: bitwise equality is not its contract — the iterated-scan tolerance
+#: model of ``external_check``, scaled to the tiny probe (observed probe
+#: divergence is ~1e-7; a wrong kernel lands orders of magnitude out).
+CONFORMANCE_REL_L2 = {
+    "flat": 0.0,
+    "blocked": 1e-5,
+    "pallas": 1e-5,
+    "pallas-fused": 1e-5,
+    "dense": 1e-5,
+}
+
+#: canonical probe instance for the conformance gate: large enough to
+#: exercise multi-block code paths in every kernel, small enough that the
+#: one-time probe is negligible next to any real solve
+_PROBE_SHAPE = dict(n=2048, p=48, q=47, iters=3, seed=1234)
+_PROBE_PROBLEM: "Problem | None" = None
+
+
+def _probe_problem() -> "Problem":
+    global _PROBE_PROBLEM
+    if _PROBE_PROBLEM is None:
+        _PROBE_PROBLEM = generate_problem(**_PROBE_SHAPE)
+    return _PROBE_PROBLEM
+
+
+def _conformance_gate(n: int, dtype):
+    """``gate(rung) -> bool`` for ``with_fallback``: first use of a
+    non-reference rung (per process × dtype) runs the canonical probe
+    through that rung and through ``flat``, compares to the rung's
+    declared tolerance, and caches the verdict
+    (``core/conformance.py``).  ``auto`` is resolved to the scan the
+    size dispatch would actually pick for ``n``, so the probed kernel is
+    the serving kernel."""
+    from ..core import conformance
+    from ..ops.segmented import BLOCKED_SCAN_THRESHOLD
+
+    def gate(rung: str) -> bool:
+        kernel = rung
+        if kernel == "auto":
+            kernel = "flat" if n < BLOCKED_SCAN_THRESHOLD else "blocked"
+        if kernel == "flat":
+            return True  # the reference rung needs no probe
+        prob = _probe_problem()
+        xx = jnp.asarray(prob.xx, dtype)
+        flags = head_flags_from_starts(jnp.asarray(prob.s[:-1]), prob.n)
+
+        def run(k):
+            return lambda: np.asarray(
+                _make_runner(prob, xx, flags, k)(jnp.asarray(prob.a, dtype)))
+
+        return conformance.check(
+            "spmv_scan", kernel, shape_class=np.dtype(dtype).name,
+            candidate=run(kernel), reference=run("flat"),
+            rel_l2=CONFORMANCE_REL_L2[kernel]).ok
+
+    return gate
+
 
 def _make_runner(prob: Problem, xx, flags, kernel: str):
     """runner(v) executing all N iterations with the named kernel."""
@@ -287,10 +348,16 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
     injected or real — demotes down ``FALLBACK_LADDERS[kernel]`` instead
     of aborting: the op completes on a working kernel and the demotion is
     recorded as structured ``rung-failed``/``served`` trace events
-    (``core/resilience.with_fallback``).  ``fallback=False`` keeps the
-    reference's fail-fast behavior.  The fault-injection guard and the
-    ladder bookkeeping run in host Python before the jitted loop launches,
-    so the healthy path times identically.
+    (``core/resilience.with_fallback``).  The ladder also consults the
+    **conformance gate**: a rung whose first-use probe diverges from the
+    ``flat`` reference beyond its declared tolerance is demoted with
+    ``WRONG_ANSWER`` before it can serve a silently-wrong result (verdict
+    cached per process — steady state is one dict lookup).
+    ``fallback=False`` keeps the reference's fail-fast behavior (and
+    skips the gate — bench rows are data, not served traffic).  The
+    fault-injection guard and the ladder bookkeeping run in host Python
+    before the jitted loop launches, so the healthy path times
+    identically.
     """
     from ..core import check_op, span, with_fallback
 
@@ -324,7 +391,9 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
         return thunk
 
     rungs = FALLBACK_LADDERS[kernel] if fallback else (kernel,)
-    res = with_fallback("spmv_scan", [(r, attempt(r)) for r in rungs])
+    gate = _conformance_gate(prob.n, dtype) if fallback else None
+    res = with_fallback("spmv_scan", [(r, attempt(r)) for r in rungs],
+                        gate=gate)
     if res.demoted:
         print(f"spmv_scan: kernel {kernel!r} demoted to {res.rung!r} "
               f"(failed: {', '.join(f.rung for f in res.failures)})")
@@ -347,7 +416,17 @@ def run_spmv_scan_checkpointed(prob: Problem, path: str, every: int = 0,
     deterministic, so an interrupted-and-resumed solve is bitwise equal to
     an uninterrupted one with the same ``every``.  ``kernel`` must be one
     of the XLA scans (auto/flat/blocked).
+
+    Memory pressure degrades instead of dying: the first chunk is
+    **preflighted** against the memory budget
+    (``core/admission.preflight`` — a resident set the budget can never
+    hold is refused up front with a structured ``admission-rejected``
+    record), and a chunk that still dies ``RESOURCE_EXHAUSTED`` at
+    runtime (real, or ``CME213_FAULTS=oom:spmv_scan_chunk``) is halved
+    and retried from the last checkpoint — bitwise-neutral, since every
+    iteration runs the same program whatever the chunk boundaries.
     """
+    from ..core import admission
     from ..core.checkpoint import run_with_checkpoints
     from ..core.resilience import all_finite
 
@@ -357,12 +436,19 @@ def run_spmv_scan_checkpointed(prob: Problem, path: str, every: int = 0,
     prob.validate()
     xx = jnp.asarray(prob.xx, dtype)
     flags = head_flags_from_starts(jnp.asarray(prob.s[:-1]), prob.n)
+    a0 = jnp.asarray(prob.a, dtype)
+    every = every or prob.iters
+    decision = admission.preflight(
+        _iterate, jnp.zeros_like(a0), xx, flags, op="spmv_scan",
+        iters=min(every, prob.iters), scan=kernel)
+    if not decision.admitted:
+        raise admission.AdmissionError(f"spmv_scan: {decision.detail}")
 
     def step(state, k):
         return _iterate(jnp.asarray(state, dtype), xx, flags, k,
                         scan=kernel)
 
-    out = run_with_checkpoints(step, jnp.asarray(prob.a, dtype), prob.iters,
+    out = run_with_checkpoints(step, a0, prob.iters,
                                path, every=every, guard=all_finite,
                                op="spmv_scan", max_retries=max_retries)
     return np.asarray(out)
@@ -376,12 +462,14 @@ def run_spmv_scan_distributed(prob: Problem, mesh, dtype=jnp.float32,
     scan inherits the flat/blocked size dispatch, so per-shard work is
     O(n/d) once shards cross the threshold.  Pads to a shard multiple
     with zero-valued, own-segment tail elements (they never affect real
-    segments)."""
-    from ..dist.scan import make_iterated_sharded_scan
+    segments).  The carry-combine backend is conformance-gated
+    (``dist/scan.make_iterated_sharded_scan_gated``): ring demotes to
+    gather if its probe diverges."""
+    from ..dist.scan import make_iterated_sharded_scan_gated
 
     prob.validate()
     a_d, xx_d, fl_d, n = _shard_problem(prob, mesh, dtype)
-    iterate = make_iterated_sharded_scan(mesh)
+    iterate, _ = make_iterated_sharded_scan_gated(mesh)
 
     timer = timer or PhaseTimer()
     iterate(jnp.zeros_like(a_d), xx_d, fl_d, prob.iters).block_until_ready()
@@ -443,10 +531,18 @@ def run_spmv_scan_distributed_supervised(prob: Problem, mesh, ckpt_dir: str,
     Same-mesh resume is bitwise; across shard counts the carry-combine
     order changes, so results match the single-device reference to the
     usual scan tolerance instead.
+
+    An epoch chunk that dies ``RESOURCE_EXHAUSTED`` (real, or
+    ``CME213_FAULTS=oom:spmv_scan_chunk``) halves ``every``, re-shards
+    from the last committed state, and retries — the distributed form of
+    the checkpointed solve's chunk-shrink response.
     """
-    from ..core.faults import maybe_kill_rank
+    from ..core import metrics
+    from ..core.faults import maybe_kill_rank, maybe_oom
+    from ..core.resilience import FailureKind, classify_failure
+    from ..core.trace import record_event
     from ..dist.ckpt import check_meta, commit_epoch, load_latest_commit
-    from ..dist.scan import make_iterated_sharded_scan
+    from ..dist.scan import make_iterated_sharded_scan_gated
 
     prob.validate()
     meta = {"kind": "spmv_scan", "n": prob.n, "iters": prob.iters,
@@ -457,23 +553,43 @@ def run_spmv_scan_distributed_supervised(prob: Problem, mesh, ckpt_dir: str,
     if jax.process_count() > 1:
         process_id, process_count = jax.process_index(), jax.process_count()
 
-    start, epoch, values = 0, 0, None
-    loaded = load_latest_commit(ckpt_dir) if resume else None
-    if loaded is not None:
+    def load_state(force: bool = False):
+        # the chunk-shrink retry always reloads (its own commits from this
+        # run are durable even when the solve started with resume=False)
+        loaded = load_latest_commit(ckpt_dir) if (resume or force) else None
+        if loaded is None:
+            return 0, 0, None
         manifest, committed = loaded
         check_meta(manifest, **meta)
-        start, epoch = manifest["step"], manifest["epoch"]
-        values = np.asarray(committed)
+        return manifest["step"], manifest["epoch"], np.asarray(committed)
+
+    start, epoch, values = load_state()
     a_d, xx_d, fl_d, n = _shard_problem(prob, mesh, dtype, values=values)
-    iterate = make_iterated_sharded_scan(mesh)
+    iterate, _ = make_iterated_sharded_scan_gated(mesh)
     if heartbeat is not None:
         heartbeat.beat(start)
     it = start
     while it < prob.iters:
         maybe_kill_rank(step=epoch)
         k = min(every, prob.iters - it)
-        a_d = iterate(a_d, xx_d, fl_d, k)
-        jax.block_until_ready(a_d)
+        try:
+            maybe_oom("spmv_scan_chunk")
+            a_new = iterate(a_d, xx_d, fl_d, k)
+            jax.block_until_ready(a_new)
+        except Exception as e:  # noqa: BLE001 — classify, then decide
+            if classify_failure(e) is not FailureKind.RESOURCE or k <= 1:
+                raise
+            every = max(1, k // 2)
+            metrics.counter("admission.chunk_shrunk").inc()
+            record_event("chunk-shrunk", op="spmv_scan", from_size=k,
+                         to_size=every, reason=type(e).__name__)
+            # the chunk may have consumed its donated shard buffers —
+            # rebuild from the last committed state (or the problem)
+            it, epoch, values = load_state(force=True)
+            a_d, xx_d, fl_d, n = _shard_problem(prob, mesh, dtype,
+                                                values=values)
+            continue
+        a_d = a_new
         it += k
         epoch += 1
         commit_epoch(ckpt_dir, epoch, it, a_d, true_shape=(n,), meta=meta,

@@ -6,7 +6,7 @@ from .resilience import (FailureKind, FallbackResult, NonFiniteError,
                          with_fallback)
 from .trace import (EVENT_SCHEMA, clear_events, events, flush_sink,
                     record_event, span, validate_record)
-from . import metrics
+from . import admission, conformance, metrics
 
 __all__ = [
     "PhaseTimer",
@@ -31,5 +31,7 @@ __all__ = [
     "flush_sink",
     "validate_record",
     "EVENT_SCHEMA",
+    "admission",
+    "conformance",
     "metrics",
 ]

@@ -1,0 +1,176 @@
+"""Memory-aware admission control — preflight before dispatch.
+
+The reference assumed its problems fit: a too-large grid died inside a
+CUDA allocation with whatever error the driver felt like printing.  On a
+TPU the equivalent is an HBM ``RESOURCE_EXHAUSTED`` mid-solve — after
+minutes of useful work, with the donated input buffers already gone.
+This module moves that discovery to *before* dispatch, and gives the
+solvers a graceful response when it happens anyway:
+
+- :func:`memory_budget` — the per-device byte budget:
+  ``CME213_MEMORY_BUDGET`` (plain bytes or ``K``/``M``/``G`` suffix) when
+  set, else the detected device memory (``memory_stats()['bytes_limit']``
+  — absent on the CPU backend, where admission is env-opt-in).
+- :func:`preflight` — lower + compile a jitted computation and read its
+  ``memory_analysis()`` (arguments + outputs + temps − donated aliases);
+  an over-budget program is **rejected** with a structured
+  ``admission-rejected`` event instead of being launched to die.
+- :func:`admit_chunk` — the degradation loop: halve a size knob (solve
+  chunk length, pipeline tile) until its preflight fits, emitting a
+  ``chunk-shrunk`` event per halving; only a floor-size program that
+  still cannot fit raises :class:`AdmissionError`.
+
+The *reactive* half lives next to the solvers: ``classify_failure``
+buckets runtime ``RESOURCE_EXHAUSTED`` into ``FailureKind.RESOURCE`` and
+the checkpointed/supervised drivers respond by halving their chunk and
+retrying from the last durable state (``core/checkpoint.py``,
+``dist/heat.py``, ``apps/spmv_scan.py``).  ``oom:<op>`` fault clauses
+(``core/faults.py``) raise a synthetic ``RESOURCE_EXHAUSTED`` so every
+response path is testable on CPU.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from . import metrics
+from .errors import FrameworkError
+from .trace import record_event
+
+#: per-device memory budget override, bytes (suffixes K/M/G accepted)
+BUDGET_ENV = "CME213_MEMORY_BUDGET"
+
+_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
+
+
+class AdmissionError(FrameworkError):
+    """The computation cannot fit the memory budget at any allowed size."""
+
+
+def parse_budget(raw: str) -> int:
+    """``"1073741824"`` / ``"512M"`` / ``"16g"`` -> bytes."""
+    raw = raw.strip().lower()
+    mult = 1
+    if raw and raw[-1] in _SUFFIX:
+        mult = _SUFFIX[raw[-1]]
+        raw = raw[:-1]
+    return int(float(raw) * mult)
+
+
+def memory_budget() -> int | None:
+    """The effective per-device byte budget, or None (admission off).
+
+    ``CME213_MEMORY_BUDGET`` wins; otherwise the first device's reported
+    ``bytes_limit`` (TPU/GPU — the CPU backend reports nothing, so CPU
+    runs only do admission when the env is set, which is also how tests
+    fake a budget).
+    """
+    raw = os.environ.get(BUDGET_ENV)
+    if raw and raw.strip():
+        try:
+            return parse_budget(raw)
+        except ValueError:
+            return None
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 — detection must never break dispatch
+        pass
+    return None
+
+
+@dataclass(frozen=True)
+class Decision:
+    admitted: bool
+    required_bytes: int | None   # None when memory analysis is unavailable
+    budget_bytes: int | None
+    detail: str
+
+
+def estimate_bytes(compiled) -> int | None:
+    """Peak-footprint estimate from a compiled computation's
+    ``memory_analysis()``: arguments + outputs + temps − donated aliases.
+    None when the backend exposes no analysis (pass-open)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001
+        return None
+    if ma is None:
+        return None
+    try:
+        return int(ma.argument_size_in_bytes + ma.output_size_in_bytes
+                   + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+    except (AttributeError, TypeError):
+        return None
+
+
+def preflight(fn, *args, op: str = "preflight", budget: int | None = None,
+              **kwargs) -> Decision:
+    """Admission decision for ``fn(*args, **kwargs)`` (``fn`` jitted).
+
+    Lowers and compiles the program (the jit cache serves the real call
+    afterwards) and compares its analyzed footprint to ``budget``
+    (default :func:`memory_budget`).  No budget, or no analysis from the
+    backend, admits pass-open — admission control must never turn a
+    healthy program away on missing information.  A rejection emits
+    ``admission-rejected`` and bumps ``admission.rejected``.
+    """
+    budget = memory_budget() if budget is None else budget
+    if budget is None:
+        return Decision(True, None, None, "no budget: admission off")
+    try:
+        compiled = fn.lower(*args, **kwargs).compile()
+    except Exception as e:  # noqa: BLE001 — compile failures belong to the
+        # fallback ladder, not admission; surface them there
+        return Decision(True, None, budget,
+                        f"preflight compile failed ({type(e).__name__}): "
+                        f"pass-open")
+    required = estimate_bytes(compiled)
+    if required is None:
+        return Decision(True, None, budget, "no memory analysis: pass-open")
+    if required > budget:
+        metrics.counter("admission.rejected").inc()
+        record_event("admission-rejected", op=op, requested_bytes=required,
+                     budget_bytes=budget,
+                     detail=f"footprint {required} > budget {budget}")
+        return Decision(False, required, budget,
+                        f"footprint {required} > budget {budget}")
+    metrics.counter("admission.admitted").inc()
+    return Decision(True, required, budget,
+                    f"footprint {required} <= budget {budget}")
+
+
+def admit_chunk(op: str, initial: int, preflight_at, floor: int = 1,
+                halve=None) -> int:
+    """Largest admitted size knob, halving down from ``initial``.
+
+    ``preflight_at(size) -> Decision`` runs the admission check at a
+    candidate size (build the jitted program for that chunk length / tile
+    height and :func:`preflight` it).  Each rejection emits a
+    ``chunk-shrunk`` event and halves (``halve(size)`` when given — e.g.
+    tile quantization — else integer halving).  A ``floor``-size program
+    that is still over budget raises :class:`AdmissionError`: the budget
+    says it can never fit, and a structured refusal beats an opaque
+    device OOM after minutes of work.
+    """
+    size = initial
+    while True:
+        decision = preflight_at(size)
+        if decision.admitted:
+            return size
+        if size <= floor:
+            raise AdmissionError(
+                f"{op}: floor size {size} still over budget "
+                f"({decision.detail})")
+        smaller = max(floor, halve(size) if halve is not None else size // 2)
+        if smaller >= size:
+            raise AdmissionError(
+                f"{op}: cannot shrink below {size} ({decision.detail})")
+        metrics.counter("admission.chunk_shrunk").inc()
+        record_event("chunk-shrunk", op=op, from_size=size, to_size=smaller,
+                     reason="admission-preflight")
+        size = smaller

@@ -178,7 +178,7 @@ def save_state_checkpoint(path: str, step: int, state) -> None:
 
 def run_with_checkpoints(step_fn, state, total_iters: int, path: str,
                          every: int = 0, guard=None, op: str = "run",
-                         max_retries: int = 1):
+                         max_retries: int = 1, chunk_op: str | None = None):
     """Drive ``state = step_fn(state, k_iters)`` in checkpointed chunks,
     resuming from ``path`` if a checkpoint exists.
 
@@ -190,10 +190,21 @@ def run_with_checkpoints(step_fn, state, total_iters: int, path: str,
     checkpoint, and the chunk is retried up to ``max_retries`` times before
     ``NonFiniteError`` is raised.  ``op`` names this solve for fault
     injection (``nan:<op>:<nth>`` poisons the Nth chunk) and trace events.
-    """
-    from .faults import maybe_poison
-    from .resilience import NonFiniteError
 
+    **Memory-aware degradation**: a chunk that dies RESOURCE-classified
+    (an HBM ``RESOURCE_EXHAUSTED``, real or injected via
+    ``oom:<chunk_op>``; ``chunk_op`` defaults to ``<op>_chunk``) halves
+    the chunk length and retries from the last good checkpoint instead of
+    aborting — chunking is arithmetic-neutral (every iteration runs the
+    same program regardless of chunk boundaries), so a shrunk-and-retried
+    solve stays bitwise equal to an uninterrupted one.  Each halving
+    emits a ``chunk-shrunk`` event; a RESOURCE failure at chunk length 1
+    re-raises (no smaller program exists).
+    """
+    from .faults import maybe_oom, maybe_poison
+    from .resilience import FailureKind, NonFiniteError, classify_failure
+
+    chunk_op = chunk_op or f"{op}_chunk"
     start = 0
     loaded = load_checkpoint(path)
     if loaded is not None:
@@ -208,8 +219,24 @@ def run_with_checkpoints(step_fn, state, total_iters: int, path: str,
     retries = 0
     while it < total_iters:
         k = min(every, total_iters - it)
-        with span("checkpoint.chunk", op=op, start=it, iters=k):
-            new_state = maybe_poison(op, step_fn(state, k))
+        try:
+            maybe_oom(chunk_op)
+            with span("checkpoint.chunk", op=op, start=it, iters=k):
+                new_state = maybe_poison(op, step_fn(state, k))
+        except Exception as e:  # noqa: BLE001 — classify, then decide
+            if classify_failure(e) is not FailureKind.RESOURCE or k <= 1:
+                raise
+            every = max(1, k // 2)
+            metrics.counter("admission.chunk_shrunk").inc()
+            record_event("chunk-shrunk", op=op, from_size=k, to_size=every,
+                         reason=type(e).__name__)
+            # the failed chunk may have consumed its (donated) input
+            # buffers — restart the chunk from the last durable state
+            loaded = load_checkpoint(path)
+            if loaded is not None:
+                it, arrays = loaded
+                state = _unflatten_state(arrays)
+            continue
         if guard is not None and not guard(new_state):
             record_event("numeric-abort", op=op, step=it + k,
                          retries=retries)

@@ -1,0 +1,187 @@
+"""Kernel conformance gating — probe-before-serve wrong-answer detection.
+
+The resilience ladder (``core/resilience.with_fallback``) demotes a rung
+that *raises* or goes non-finite, but a kernel can fail worse than that:
+it can return a wrong-but-finite grid that every downstream guard happily
+serves.  The reference's defense was its dual-implementation methodology —
+every kernel diffed against a golden before results were trusted
+(``hw2``'s ``grid_final_*`` comparisons, the hw_final external checker) —
+applied *offline*, once, by a human.  This module is that check moved
+into the serving path, made cheap enough to leave on:
+
+- On the **first use** of a non-reference rung (per process × op × shape
+  class), :func:`check` runs a small canonical probe problem through the
+  candidate rung and through the op's reference rung (``flat`` scan,
+  ``xla`` stencil), and compares — bitwise by default, or to the rung's
+  declared tolerance (``max_ulps`` / ``rel_l2``) for kernels whose
+  accumulation order legitimately differs.
+- A diverging rung records a ``conformance-failed`` event and is demoted
+  by the caller exactly like a rung that raised (``FailureKind.
+  WRONG_ANSWER``); a matching rung is served.
+- Verdicts are **cached** in-process (steady state: one dict lookup) and
+  optionally on disk (``CME213_CONFORMANCE_CACHE=<json path>``) so long-
+  lived fleets pay the probe once per binary, not once per process.
+
+The probe is sampling, not proof: a rung that matches on the probe can
+still diverge on some other shape — the shape class (dtype, stencil
+order, temporal-blocking factor, ...) is chosen so the known divergence
+axes are probed separately.  ``wrong:<op>`` fault clauses
+(``core/faults.py``) perturb a probe output deterministically, so the
+whole gate is testable on CPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import metrics
+from .trace import record_event
+
+#: optional on-disk verdict cache (JSON) shared across processes
+CACHE_ENV = "CME213_CONFORMANCE_CACHE"
+
+
+@dataclass(frozen=True)
+class Verdict:
+    """Outcome of one conformance probe (or its cached replay)."""
+
+    ok: bool
+    detail: str          # "bitwise" / "rel_l2=1.2e-07<=1e-05" / mismatch
+    cached: bool = False
+
+
+# (op, rung, shape_class) -> Verdict — the steady-state dict lookup
+_VERDICTS: dict[tuple[str, str, str], Verdict] = {}
+_DISK_LOADED = False
+
+
+def reset() -> None:
+    """Forget every cached verdict (tests); the disk cache is re-read."""
+    global _DISK_LOADED
+    _VERDICTS.clear()
+    _DISK_LOADED = False
+
+
+def _cache_key(op: str, rung: str, shape_class: str) -> str:
+    return f"{op}|{rung}|{shape_class}"
+
+
+def _load_disk_cache() -> None:
+    """Merge persisted verdicts (non-destructively: in-process wins)."""
+    global _DISK_LOADED
+    _DISK_LOADED = True
+    path = os.environ.get(CACHE_ENV)
+    if not path or not os.path.exists(path):
+        return
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return  # a corrupt cache must never block serving; probes re-run
+    for key, v in data.items():
+        parts = key.split("|")
+        if len(parts) != 3 or not isinstance(v, dict) or "ok" not in v:
+            continue
+        tup = (parts[0], parts[1], parts[2])
+        _VERDICTS.setdefault(tup, Verdict(
+            ok=bool(v["ok"]), detail=str(v.get("detail", "disk-cache")),
+            cached=True))
+
+
+def _persist(op: str, rung: str, shape_class: str, verdict: Verdict) -> None:
+    path = os.environ.get(CACHE_ENV)
+    if not path:
+        return
+    try:
+        data = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except (OSError, ValueError):
+        data = {}
+    data[_cache_key(op, rung, shape_class)] = {
+        "ok": verdict.ok, "detail": verdict.detail}
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=0, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # a read-only cache dir must never block serving
+
+
+def _compare(out, ref, rel_l2: float, max_ulps: int) -> tuple[bool, str]:
+    """(ok, detail) for candidate vs reference probe outputs."""
+    out = np.asarray(out)
+    ref = np.asarray(ref)
+    if out.shape != ref.shape or out.dtype != ref.dtype:
+        return False, (f"shape/dtype mismatch: {out.dtype}{out.shape} vs "
+                       f"{ref.dtype}{ref.shape}")
+    if not np.isfinite(out).all():
+        return False, "non-finite candidate output"
+    if max_ulps:
+        from .compare import ulp_distance
+
+        d = int(np.max(ulp_distance(ref, out))) if out.size else 0
+        return d <= max_ulps, f"ulps={d} (tol {max_ulps})"
+    if rel_l2:
+        denom = float(np.linalg.norm(ref.astype(np.float64)))
+        err = (float(np.linalg.norm((out - ref).astype(np.float64)))
+               / max(denom, np.finfo(np.float64).tiny))
+        return err <= rel_l2, f"rel_l2={err:.3e} (tol {rel_l2:g})"
+    n_bad = int(np.count_nonzero(out != ref))
+    return n_bad == 0, ("bitwise" if n_bad == 0
+                        else f"bitwise mismatch ({n_bad}/{out.size} elems)")
+
+
+def check(op: str, rung: str, shape_class: str, candidate, reference,
+          rel_l2: float = 0.0, max_ulps: int = 0) -> Verdict:
+    """Probe ``rung`` against the op's reference rung; cached per
+    (op, rung, shape_class).
+
+    ``candidate``/``reference`` are zero-arg callables returning the probe
+    outputs (arrays); they run only on a cache miss.  The comparison is
+    bitwise unless the rung declares a tolerance (``max_ulps`` wins over
+    ``rel_l2``).  The candidate output passes through ``faults.
+    maybe_perturb(op, ...)`` so ``wrong:<op>`` clauses can poison exactly
+    one probe.  Divergence records a ``conformance-failed`` event; every
+    actual probe records ``conformance-probe``.
+    """
+    if not _DISK_LOADED:
+        _load_disk_cache()
+    key = (op, rung, shape_class)
+    hit = _VERDICTS.get(key)
+    if hit is not None:
+        metrics.counter("conformance.cache_hits").inc()
+        return Verdict(hit.ok, hit.detail, cached=True)
+
+    from .faults import maybe_perturb
+
+    start = time.perf_counter()
+    out = maybe_perturb(op, candidate())
+    ref = reference()
+    ok, detail = _compare(out, ref, rel_l2, max_ulps)
+    ms = round((time.perf_counter() - start) * 1e3, 3)
+    verdict = Verdict(ok, detail)
+    _VERDICTS[key] = verdict
+    metrics.counter("conformance.probes").inc()
+    record_event("conformance-probe", op=op, rung=rung,
+                 shape_class=shape_class, ok=ok, ms=ms)
+    if not ok:
+        metrics.counter("conformance.failed").inc()
+        record_event("conformance-failed", op=op, rung=rung,
+                     shape_class=shape_class, detail=detail)
+    _persist(op, rung, shape_class, verdict)
+    return verdict
+
+
+def verdicts() -> dict:
+    """Snapshot of cached verdicts (introspection/tests)."""
+    return {_cache_key(*k): v for k, v in _VERDICTS.items()}

@@ -40,6 +40,19 @@ Spec grammar (comma-separated clauses)::
                                   process's first incarnation
                                   (``CME213_INCARNATION`` unset or 0) — so a
                                   launcher restart survives deterministically
+    wrong:<op>[:<nth>]            the <nth> call of ``maybe_perturb(op, v)``
+                                  returns ``v`` with ONE element of its
+                                  first float leaf perturbed (finite, large)
+                                  — the silently-wrong kernel the
+                                  conformance gate (``core/conformance.py``)
+                                  exists to catch; first incarnation only,
+                                  like rankkill
+    oom:<op>[:<nth>]              the <nth> call of ``maybe_oom(op)`` raises
+                                  a synthetic RESOURCE_EXHAUSTED
+                                  (``InjectedResourceExhausted``) — the HBM
+                                  out-of-memory the admission layer
+                                  (``core/admission.py``) degrades under;
+                                  first incarnation only
 
 Op names are dotted paths (``spmv_scan.pallas-fused``, ``heat.pipeline``,
 ``sweep.heat_bandwidth``); colons are reserved for the grammar.
@@ -66,13 +79,18 @@ class InjectedFault(RuntimeError):
     injected = True
 
 
+class InjectedResourceExhausted(InjectedFault):
+    """Synthetic out-of-memory (stands in for an HBM RESOURCE_EXHAUSTED);
+    classified as ``FailureKind.RESOURCE`` by ``classify_failure``."""
+
+
 class FaultSpecError(ValueError):
     """Malformed CME213_FAULTS clause."""
 
 
 @dataclass
 class _Clause:
-    kind: str           # fail | nan | ckpt | rankkill
+    kind: str           # fail | nan | ckpt | rankkill | wrong | oom
     op: str             # op name ("truncate" for ckpt; rank id for rankkill)
     nth: int = 1        # 1-based trigger call (rankkill: 0-based step)
     count: int = 1      # consecutive triggered calls (fail only)
@@ -97,18 +115,19 @@ class FaultPlan:
                 continue
             parts = raw.split(":")
             kind = parts[0]
-            if kind not in ("fail", "nan", "ckpt", "rankkill") or len(parts) < 2:
+            if (kind not in ("fail", "nan", "ckpt", "rankkill", "wrong",
+                             "oom") or len(parts) < 2):
                 raise FaultSpecError(
                     f"bad fault clause {raw!r} (kinds: fail:<op>[:nth[:count]]"
-                    f", nan:<op>[:nth], ckpt:truncate[:nth], "
-                    f"rankkill:<rank>[:step])")
+                    f", nan:<op>[:nth], wrong:<op>[:nth], oom:<op>[:nth], "
+                    f"ckpt:truncate[:nth], rankkill:<rank>[:step])")
             try:
                 if kind == "fail":
                     clauses.append(_Clause(
                         kind, parts[1],
                         nth=int(parts[2]) if len(parts) > 2 else 1,
                         count=int(parts[3]) if len(parts) > 3 else 1))
-                elif kind == "nan":
+                elif kind in ("nan", "wrong", "oom"):
                     clauses.append(_Clause(
                         kind, parts[1],
                         nth=int(parts[2]) if len(parts) > 2 else 1))
@@ -214,6 +233,55 @@ def maybe_poison(op: str, state):
             _record("nan", op, leaf=i)
             break
     return treedef.unflatten(leaves) if treedef is not None else leaves[0]
+
+
+def maybe_perturb(op: str, value):
+    """Perturb ONE element of ``value``'s first float leaf if a
+    ``wrong:<op>`` clause fires on this call — the silently-wrong kernel
+    the conformance gate exists to catch.  The perturbation is finite and
+    large (``x -> x + 1 + |x|``), so it trips both bitwise and declared-
+    tolerance comparisons.  First incarnation only (like ``rankkill``), so
+    a restarted gang re-probes clean.  Returns ``value`` unchanged when no
+    clause fires; never mutates device buffers."""
+    plan = active()
+    if plan is None:
+        return value
+    fire = any(c.fires() for c in plan._matching("wrong", op))
+    if not fire or incarnation() != 0:
+        return value
+    import numpy as np
+
+    try:
+        from jax import tree_util
+        leaves, treedef = tree_util.tree_flatten(value)
+    except ImportError:  # pragma: no cover - jax always present here
+        leaves, treedef = [value], None
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and arr.size:
+            arr = np.array(arr)  # host copy; never mutate a device buffer
+            flat = arr.reshape(-1)
+            flat[0] = flat[0] + 1.0 + abs(flat[0])
+            leaves[i] = arr
+            _record("wrong", op, leaf=i)
+            break
+    return treedef.unflatten(leaves) if treedef is not None else leaves[0]
+
+
+def maybe_oom(op: str) -> None:
+    """Raise a synthetic RESOURCE_EXHAUSTED if an ``oom:<op>`` clause
+    fires on this call — the injected HBM out-of-memory the admission
+    layer's chunk-shrink response is tested against.  First incarnation
+    only, so a restarted solve retries clean."""
+    plan = active()
+    if plan is None:
+        return
+    for c in plan._matching("oom", op):
+        if c.fires() and incarnation() == 0:
+            _record("oom", op, call=c.calls)
+            raise InjectedResourceExhausted(
+                f"RESOURCE_EXHAUSTED: injected out-of-memory in {op} "
+                f"(call {c.calls})")
 
 
 def maybe_truncate_file(path: str) -> bool:

@@ -11,8 +11,14 @@ pointless; rolling back to a checkpoint is not).  Three pieces:
 - ``classify_failure`` buckets an exception as COMPILE (lowering/Mosaic/
   unsupported-op — deterministic, never retried on the same rung), NUMERIC
   (non-finite values — handled by checkpoint rollback, see
-  ``core/checkpoint.run_with_checkpoints``), or RUNTIME (everything else,
-  including XlaRuntimeError and injected faults — retryable).
+  ``core/checkpoint.run_with_checkpoints``), RESOURCE (an HBM
+  RESOURCE_EXHAUSTED — retrying the same program refinds the same wall;
+  the response is *shrinking*: halve the solve chunk / pipeline tile and
+  retry, see ``core/admission.py``), or RUNTIME (everything else,
+  including XlaRuntimeError and injected faults — retryable).  A fifth
+  kind, WRONG_ANSWER, is never produced by classification — it is
+  assigned by the conformance gate (``core/conformance.py``) when a rung
+  returns finite-but-divergent results on its probe.
 - ``RetryPolicy`` — bounded attempts with a deterministic geometric backoff
   (no jitter: CI reproducibility beats thundering-herd avoidance at this
   scale).
@@ -41,6 +47,8 @@ class FailureKind(str, Enum):
     COMPILE = "compile"
     RUNTIME = "runtime"
     NUMERIC = "numeric"
+    RESOURCE = "resource"           # out of device memory: shrink, don't retry
+    WRONG_ANSWER = "wrong_answer"   # conformance probe diverged: demote
 
 
 class NonFiniteError(ArithmeticError):
@@ -53,12 +61,22 @@ class NonFiniteError(ArithmeticError):
 _COMPILE_MARKERS = ("mosaic", "lowering", "lower", "compil", "unsupported",
                     "unimplemented", "vmem", "mlir")
 _NUMERIC_MARKERS = ("nan", "non-finite", "not finite", "overflow")
+# runtime HBM exhaustion (XlaRuntimeError RESOURCE_EXHAUSTED and friends);
+# compile-time VMEM over-budget stays COMPILE — a different kernel
+# formulation can fix that, while no reformulation shrinks the arrays
+_RESOURCE_MARKERS = ("resource_exhausted", "resource exhausted",
+                     "out of memory", "out-of-memory")
 
 
 def classify_failure(exc: BaseException) -> FailureKind:
-    """COMPILE / NUMERIC / RUNTIME bucket for a caught exception."""
+    """COMPILE / NUMERIC / RESOURCE / RUNTIME bucket for a caught
+    exception."""
+    from .faults import InjectedResourceExhausted
+
     if isinstance(exc, (NonFiniteError, FloatingPointError, ZeroDivisionError)):
         return FailureKind.NUMERIC
+    if isinstance(exc, InjectedResourceExhausted):
+        return FailureKind.RESOURCE
     if isinstance(exc, FrameworkError) and exc.__cause__ is not None:
         return classify_failure(exc.__cause__)
     if isinstance(exc, NotImplementedError):
@@ -66,6 +84,8 @@ def classify_failure(exc: BaseException) -> FailureKind:
     msg = f"{type(exc).__name__}: {exc}".lower()
     if any(m in msg for m in _NUMERIC_MARKERS):
         return FailureKind.NUMERIC
+    if any(m in msg for m in _RESOURCE_MARKERS):
+        return FailureKind.RESOURCE
     if any(m in msg for m in _COMPILE_MARKERS):
         return FailureKind.COMPILE
     return FailureKind.RUNTIME
@@ -147,23 +167,49 @@ class FallbackResult:
         return bool(self.failures)
 
 
-def with_fallback(op: str, ladder, policy: RetryPolicy | None = None
-                  ) -> FallbackResult:
+def with_fallback(op: str, ladder, policy: RetryPolicy | None = None,
+                  gate=None) -> FallbackResult:
     """Run the first rung of ``ladder`` (a sequence of ``(name, thunk)``)
     that succeeds, demoting down the ladder on failure.
 
-    Per rung: the fault plan is consulted first (``maybe_fail(f"{op}.{name}")``
-    — an injected failure demotes exactly like a real one), then the thunk
-    runs (under ``policy`` when given, which retries transient RUNTIME
-    failures *within* the rung before demoting).  Each failed rung emits a
-    structured ``rung-failed`` event; the serving rung emits ``served`` with
-    ``demoted`` and the failure list, so capture logs show which kernel
-    actually handled the request.  All-rungs-failed raises FrameworkError
-    chained to the last failure.
+    Per rung: the conformance ``gate`` is consulted first when given
+    (``gate(name) -> bool`` — typically a closure over
+    ``core/conformance.check``; a False verdict or a raising probe demotes
+    with ``FailureKind.WRONG_ANSWER`` exactly like a rung exception), then
+    the fault plan (``maybe_fail(f"{op}.{name}")`` — an injected failure
+    demotes exactly like a real one), then the thunk runs (under
+    ``policy`` when given, which retries transient RUNTIME failures
+    *within* the rung before demoting).  Each failed rung emits a
+    structured ``rung-failed`` event; the serving rung emits ``served``
+    with ``demoted`` and the failure list, so capture logs show which
+    kernel actually handled the request.  All-rungs-failed raises
+    FrameworkError chained to the last failure.
     """
     failures: list[RungFailure] = []
     last: Exception | None = None
     for name, thunk in ladder:
+        if gate is not None:
+            try:
+                admitted = gate(name)
+            except Exception as e:  # noqa: BLE001 — a crashed probe is a
+                # rung failure: the rung cannot even run its probe problem
+                kind = classify_failure(e)
+                failures.append(RungFailure(name, kind, type(e).__name__,
+                                            str(e)[:300]))
+                metrics.counter("fallback.demotions").inc()
+                record_event("rung-failed", op=op, rung=name,
+                             kind=kind.value, error=type(e).__name__)
+                last = e
+                continue
+            if not admitted:
+                failures.append(RungFailure(
+                    name, FailureKind.WRONG_ANSWER, "ConformanceFailed",
+                    "probe output diverged from the reference rung"))
+                metrics.counter("fallback.demotions").inc()
+                record_event("rung-failed", op=op, rung=name,
+                             kind=FailureKind.WRONG_ANSWER.value,
+                             error="ConformanceFailed")
+                continue
         try:
             maybe_fail(f"{op}.{name}")
             value = (thunk() if policy is None

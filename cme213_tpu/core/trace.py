@@ -79,6 +79,13 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "served": ("op", "rung", "demoted", "failed_rungs"),
     # fault injection (core/faults.py)
     "fault-injected": ("kind", "op"),
+    # conformance gating (core/conformance.py)
+    "conformance-probe": ("op", "rung", "shape_class", "ok", "ms"),
+    "conformance-failed": ("op", "rung", "shape_class", "detail"),
+    # admission control (core/admission.py, core/checkpoint.py,
+    # ops/stencil_pipeline.py, dist solvers)
+    "admission-rejected": ("op", "requested_bytes", "budget_bytes", "detail"),
+    "chunk-shrunk": ("op", "from_size", "to_size", "reason"),
     # single-process checkpoints (core/checkpoint.py)
     "checkpoint-quarantine": ("path", "quarantined_to", "error", "message"),
     "numeric-abort": ("op", "step", "retries"),

@@ -123,6 +123,56 @@ def make_iterated_sharded_scan(mesh: Mesh, axis_name: str | None = None,
     return iterate
 
 
+def make_iterated_sharded_scan_gated(mesh: Mesh, axis_name: str | None = None):
+    """``make_iterated_sharded_scan`` behind the conformance gate.
+
+    The carry-combine backends form a natural ladder — ``ring`` (log-P
+    ppermute, the fast path) demoting to ``gather`` (all_gather + local
+    prefix, structurally simpler) — and each mode's first use per process
+    is probed: a small deterministic sharded scan against the
+    single-device ``segmented_scan_flat`` reference, to the iterated-scan
+    tolerance (both modes reorder the carry combine, so bitwise is not
+    their contract).  A mode whose probe diverges (real, or
+    ``CME213_FAULTS=wrong:dist_scan``) is demoted with ``WRONG_ANSWER``
+    before it can serve.  Returns ``(iterate, carry_mode)``.
+    """
+    import numpy as np
+
+    from ..core import conformance
+    from ..core.resilience import with_fallback
+    from ..ops.segmented import segmented_scan_flat
+
+    axis_name = axis_name or mesh.axis_names[0]
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+
+    def gate(mode: str) -> bool:
+        def probe():
+            n = 64 * axis_size
+            values = jnp.asarray(
+                np.sin(np.arange(n, dtype=np.float32)) + 0.5)
+            flags = jnp.asarray((np.arange(n) % 23 == 0).astype(np.int32))
+            return np.asarray(distributed_segmented_scan(
+                values, flags, mesh, axis_name, carry_mode=mode))
+
+        def reference():
+            n = 64 * axis_size
+            values = jnp.asarray(
+                np.sin(np.arange(n, dtype=np.float32)) + 0.5)
+            flags = jnp.asarray((np.arange(n) % 23 == 0).astype(np.int32))
+            return np.asarray(segmented_scan_flat(values, flags))
+
+        return conformance.check(
+            "dist_scan", mode, shape_class=f"p{axis_size}",
+            candidate=probe, reference=reference, rel_l2=1e-5).ok
+
+    res = with_fallback(
+        "dist_scan",
+        [(mode, lambda m=mode: make_iterated_sharded_scan(
+            mesh, axis_name, carry_mode=m)) for mode in ("ring", "gather")],
+        gate=gate)
+    return res.value, res.rung
+
+
 def distributed_segmented_scan(values: jnp.ndarray, head_flags: jnp.ndarray,
                                mesh: Mesh, axis_name: str | None = None,
                                carry_mode: str = "ring"):

@@ -200,27 +200,104 @@ def pick_pipeline_tile(gy: int, k: int, order: int, target: int = 256,
     inputs and the output block — stays under ``VMEM_BUDGET_BYTES``,
     so a known-over-budget tile is never even offered to the compiler
     (a crashed remote compile can wedge the tunnel for every later
-    kernel, the BENCH_r02 failure mode).
+    kernel, the BENCH_r02 failure mode).  An explicit
+    ``CME213_MEMORY_BUDGET`` below the VMEM budget clamps further — the
+    admission-control knob (``core/admission.py``) reaches the tile
+    choice the same way it reaches solve chunk sizes.
     """
     b = BORDER_FOR_ORDER[order]
     kpad = _ceil_to(k * b, SUBLANE)
     t = max(_ceil_to(min(target, gy), kpad), kpad)
     if width is not None:
+        from ..core import admission
+
+        budget = VMEM_BUDGET_BYTES
+        configured = admission.memory_budget()
+        if configured is not None:
+            budget = min(budget, configured)
         W = _ceil_to(width, LANE)
 
         def footprint(ty: int) -> int:
             return 2 * dtype_bytes * W * (2 * ty + 2 * kpad)
 
-        while t > kpad and footprint(t) > VMEM_BUDGET_BYTES:
+        while t > kpad and footprint(t) > budget:
             t -= kpad
     return t
+
+
+#: canonical conformance-probe state: the nonuniform-interior +
+#: distinct-BC configuration that empirically maximizes rounding-path
+#: coverage (the shape the bitwise pin tests use)
+_PROBE_BC = (1.5, 0.5, 2.0, 0.25)
+
+
+def _conformance_probe_grid(order: int):
+    """(params, u0) small canonical probe: gradient interior, distinct
+    Dirichlet values on all four sides."""
+    import numpy as np
+
+    from ..config import SimParams
+    from ..grid import make_initial_grid
+
+    p = SimParams(nx=44, ny=40, order=order, iters=1, bc_top=_PROBE_BC[0],
+                  bc_left=_PROBE_BC[1], bc_bottom=_PROBE_BC[2],
+                  bc_right=_PROBE_BC[3])
+    u0 = np.array(make_initial_grid(p, dtype=jnp.float32))
+    b = BORDER_FOR_ORDER[order]
+    u0[b:-b, b:-b] += np.linspace(0, 1, p.ny * p.nx,
+                                  dtype=np.float32).reshape(p.ny, p.nx)
+    return p, u0
+
+
+def _heat_conformance_gate(order: int, k: int, tile_x: int, interpret: bool):
+    """``gate(rung) -> bool`` for the heat ladder: first use of a Pallas
+    rung (per process × order × k) runs the canonical probe through that
+    rung and the XLA reference, bitwise — the kernel-equality contract.
+    On this repo's known divergence axes (order 8 / temporal blocking,
+    see docs/resilience.md "Guarded execution") the probe fails and the
+    gate keeps those rungs out of the serving ladder."""
+    import numpy as np
+
+    from ..core import conformance
+    from .stencil import run_heat
+
+    def gate(rung: str) -> bool:
+        if rung == "xla":
+            return True  # the reference rung needs no probe
+        p, u0 = _conformance_probe_grid(order)
+        iters = 4 * k
+        ty = pick_pipeline_tile(u0.shape[0], k, order, target=64,
+                                width=u0.shape[1])
+
+        def candidate():
+            if rung == "pipeline":
+                out = run_heat_pipeline(jnp.array(u0), iters, order, p.xcfl,
+                                        p.ycfl, p.bc, k=k, tile_y=ty,
+                                        interpret=interpret)
+            else:
+                out = run_heat_pipeline2d(jnp.array(u0), iters, order,
+                                          p.xcfl, p.ycfl, p.bc, k=k,
+                                          tile_y=ty, tile_x=tile_x,
+                                          interpret=interpret)
+            return np.asarray(out)
+
+        def reference():
+            return np.asarray(run_heat(jnp.array(u0), iters, order,
+                                       p.xcfl, p.ycfl))
+
+        return conformance.check("heat", rung,
+                                 shape_class=f"order{order}/k{k}",
+                                 candidate=candidate, reference=reference).ok
+
+    return gate
 
 
 def run_heat_resilient(u, iters: int, order: int, xcfl, ycfl,
                        bc: tuple[float, float, float, float], k: int = 1,
                        tile_y: int | None = None, tile_x: int = 512,
                        interpret: bool = False, timer=None,
-                       phase_label: str = "gpu computation shared"):
+                       phase_label: str = "gpu computation shared",
+                       conformance: bool = True):
     """Heat stencil behind the kernel fallback ladder: pipelined Pallas
     (1-D full-width band) → column-tiled Pallas → XLA fused slices.
 
@@ -228,9 +305,21 @@ def run_heat_resilient(u, iters: int, order: int, xcfl, ycfl,
     (width, tile) cell, a preempted backend, or an injected
     ``CME213_FAULTS=fail:heat.pipeline`` — demotes to the next instead of
     aborting the solve; every kernel form is bitwise-equal on the
-    interior, so a demoted run returns the same grid.  Per rung: untimed
-    warmup behind a named ``check_op`` barrier (failures surface there,
-    attributed), then the timed run under ``timer``/``phase_label``.
+    interior, so a demoted run returns the same grid.  That equality
+    contract is *enforced*, not assumed: with ``conformance`` (default),
+    each Pallas rung's first use per process × (order, k) runs a small
+    bitwise probe against the XLA reference and a diverging rung is
+    demoted with ``WRONG_ANSWER`` before it can serve
+    (``core/conformance.py``; steady state is one dict lookup).
+
+    Per rung: untimed warmup behind a named ``check_op`` barrier
+    (failures surface there, attributed), then the timed run under
+    ``timer``/``phase_label``.  A Pallas rung that dies
+    ``RESOURCE_EXHAUSTED`` (real, or ``CME213_FAULTS=oom:heat.pipeline``)
+    **halves its tile_y** (down to the halo quantum) and retries before
+    demoting — the admission-control response applied to the tile knob,
+    with each halving recorded as a ``chunk-shrunk`` event.
+
     Returns a ``FallbackResult`` whose ``.value`` is the solved grid and
     ``.rung`` the kernel that actually served; demotions are recorded as
     structured ``rung-failed``/``served`` trace events.  The ladder
@@ -240,17 +329,25 @@ def run_heat_resilient(u, iters: int, order: int, xcfl, ycfl,
     """
     import jax.numpy as jnp
 
-    from ..core import PhaseTimer, check_op, span, with_fallback
+    from ..core import PhaseTimer, check_op, metrics, span, with_fallback
+    from ..core.faults import maybe_oom
+    from ..core.resilience import FailureKind, classify_failure
+    from ..core.trace import record_event
     from .stencil import run_heat
 
     b = BORDER_FOR_ORDER[order]
+    kpad = _ceil_to(k * b, SUBLANE)
     gy, gx = u.shape
     ty = tile_y or pick_pipeline_tile(gy, k, order, width=gx)
     timer = timer or PhaseTimer()
     u_host = jax.device_get(u)  # rungs donate; each attempt re-uploads
 
-    def timed(rung, runner):
-        def thunk():
+    def timed(rung, runner_at_tile, shrinkable=True):
+        # runner_at_tile(ty)(v): the tile knob stays adjustable so a
+        # RESOURCE failure can halve it and retry within the rung
+        def attempt(ty_cur):
+            runner = runner_at_tile(ty_cur)
+            maybe_oom(f"heat.{rung}")
             # compile vs run split per rung, like spmv_scan's dispatch
             with span("heat.compile", kernel=rung):
                 check_op(f"heat.{rung}", runner(jnp.array(u_host)))
@@ -259,19 +356,41 @@ def run_heat_resilient(u, iters: int, order: int, xcfl, ycfl,
                     out = runner(jnp.array(u_host))
                     ph.block(out)
             return out
+
+        def thunk():
+            ty_cur = ty
+            while True:
+                try:
+                    return attempt(ty_cur)
+                except Exception as e:  # noqa: BLE001 — classify first
+                    if (not shrinkable or ty_cur <= kpad
+                            or classify_failure(e)
+                            is not FailureKind.RESOURCE):
+                        raise
+                    ty_new = max(kpad, _ceil_to(ty_cur // 2, kpad))
+                    if ty_new >= ty_cur:
+                        raise
+                    metrics.counter("admission.chunk_shrunk").inc()
+                    record_event("chunk-shrunk", op=f"heat.{rung}",
+                                 from_size=ty_cur, to_size=ty_new,
+                                 reason=type(e).__name__)
+                    ty_cur = ty_new
         return thunk
 
-    ladder = [("pipeline", timed("pipeline", lambda v: run_heat_pipeline(
-        v, iters, order, xcfl, ycfl, bc, k=k, tile_y=ty,
-        interpret=interpret)))]
+    ladder = [("pipeline", timed("pipeline", lambda t: lambda v:
+              run_heat_pipeline(v, iters, order, xcfl, ycfl, bc, k=k,
+                                tile_y=t, interpret=interpret)))]
     if k * b <= LANE:  # the column-tiled form's side-halo limit
         ladder.append(("pipeline2d", timed(
-            "pipeline2d", lambda v: run_heat_pipeline2d(
-                v, iters, order, xcfl, ycfl, bc, k=k, tile_y=ty,
+            "pipeline2d", lambda t: lambda v: run_heat_pipeline2d(
+                v, iters, order, xcfl, ycfl, bc, k=k, tile_y=t,
                 tile_x=tile_x, interpret=interpret))))
-    ladder.append(("xla", timed("xla", lambda v: run_heat(
-        v, iters, order, xcfl, ycfl))))
-    return with_fallback("heat", ladder)
+    ladder.append(("xla", timed(
+        "xla", lambda t: lambda v: run_heat(v, iters, order, xcfl, ycfl),
+        shrinkable=False)))
+    gate = (_heat_conformance_gate(order, k, tile_x, interpret)
+            if conformance else None)
+    return with_fallback("heat", ladder, gate=gate)
 
 
 def _make_tiled_kernel(order: int, k: int, tile_y: int, tile_x: int,

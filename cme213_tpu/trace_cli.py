@@ -146,6 +146,37 @@ def summarize(events: list[dict], out=None) -> dict:
         for (op, rung), n in sorted(rung_failed.items()):
             w(f"  {op}.{rung} x{n}\n")
 
+    # conformance verdicts (core/conformance.py): probes run and how
+    # many diverged — the served/demoted counts above show the effect
+    conf = Counter((e.get("op"), e.get("rung"), bool(e.get("ok")))
+                   for e in events if e["event"] == "conformance-probe")
+    conf_failed = [e for e in events if e["event"] == "conformance-failed"]
+    if conf or conf_failed:
+        n_pass = sum(n for (_, _, ok), n in conf.items() if ok)
+        n_fail = sum(n for (_, _, ok), n in conf.items() if not ok)
+        w(f"conformance: {n_pass + n_fail} probe(s), {n_pass} passed, "
+          f"{n_fail} failed\n")
+        for (op, rung, ok), n in sorted(conf.items(), key=lambda kv: (
+                str(kv[0][0]), str(kv[0][1]))):
+            w(f"  {op}.{rung}: {'pass' if ok else 'FAIL'} x{n}\n")
+        for e in conf_failed:
+            w(f"  failed: {e.get('op')}.{e.get('rung')} "
+              f"[{e.get('shape_class')}] {e.get('detail')}\n")
+
+    # admission decisions (core/admission.py): rejections and the
+    # chunk/tile shrink responses
+    rejected = [e for e in events if e["event"] == "admission-rejected"]
+    shrunk = [e for e in events if e["event"] == "chunk-shrunk"]
+    if rejected or shrunk:
+        w(f"admission: {len(rejected)} rejected, {len(shrunk)} "
+          f"chunk(s)/tile(s) shrunk\n")
+        for e in rejected:
+            w(f"  rejected: {e.get('op')} needs {e.get('requested_bytes')}"
+              f" B > budget {e.get('budget_bytes')} B\n")
+        for e in shrunk:
+            w(f"  shrunk: {e.get('op')} {e.get('from_size')} -> "
+              f"{e.get('to_size')} ({e.get('reason')})\n")
+
     commits = [e for e in events if e["event"] == "epoch-commit"]
     commit_stats = None
     if commits:
@@ -205,6 +236,9 @@ def summarize(events: list[dict], out=None) -> dict:
             "commits": len(commits), "commit_ms": commit_stats,
             "resumes": len(loads), "verdicts": len(verdicts),
             "restarts": len(restarts), "invalid": dict(invalid),
+            "conformance": {f"{op}.{rung}": {"ok": ok, "count": n}
+                            for (op, rung, ok), n in conf.items()},
+            "admission": {"rejected": len(rejected), "shrunk": len(shrunk)},
             "counts": dict(counts)}
 
 
@@ -266,8 +300,11 @@ def main(argv: list[str] | None = None) -> int:
     p_sum = sub.add_parser("summary", help="aggregate report over traces")
     p_sum.add_argument("files", nargs="+")
     p_sum.add_argument("--require", default="",
-                       help="comma-separated span names that must have "
-                            "completed (exit 1 otherwise — the CI gate)")
+                       help="comma-separated span OR event names that must "
+                            "appear (a span must have completed; an event "
+                            "name — e.g. conformance-failed — must occur "
+                            "at least once); exit 1 otherwise — the CI "
+                            "gate")
 
     p_tl = sub.add_parser("timeline", help="chronological event listing")
     p_tl.add_argument("files", nargs="+")
@@ -292,9 +329,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "summary":
         agg = summarize(events)
         required = [s.strip() for s in args.require.split(",") if s.strip()]
-        missing = [s for s in required if s not in agg["spans"]]
+        missing = [s for s in required
+                   if s not in agg["spans"] and not agg["counts"].get(s)]
         if missing:
-            print(f"trace: required span(s) never completed: "
+            print(f"trace: required span(s)/event(s) never appeared: "
                   f"{', '.join(missing)}", file=sys.stderr)
             return 1
         return 0

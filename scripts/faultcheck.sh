@@ -27,6 +27,14 @@
 #  6. `trace summary`/`timeline`/`merge --timeline` over those files must
 #     parse cleanly and contain the required commit spans + the full
 #     recovery arc (rankkill -> verdict -> restart -> resume).
+# And per ISSUE 5 (guarded execution):
+#  7. conformance gate: an injected wrong-answer probe (`wrong:`) demotes
+#     the poisoned rung, the served result is f64-checked correct, and
+#     the trace CLI finds the conformance-failed event (--require gate);
+#  8. admission control: an injected RESOURCE_EXHAUSTED (`oom:`) makes
+#     the checkpointed heat solve shrink its chunk, retry, and complete
+#     bitwise-equal to an un-faulted run, with the chunk-shrunk event in
+#     the trace.
 # On ANY failing step the merged gang timeline is printed for
 # debuggability before the workspace is cleaned up.
 set -euo pipefail
@@ -47,7 +55,7 @@ on_exit() {
 }
 trap on_exit EXIT
 
-echo "== 1/6 run_all: injected sweep failure -> retry + failures.json"
+echo "== 1/8 run_all: injected sweep failure -> retry + failures.json"
 CME213_FAULTS="fail:sweep.scan_bandwidth" \
     python -m cme213_tpu.bench.run_all --quick --out "$OUT" \
     --only scan_bandwidth
@@ -59,7 +67,7 @@ assert [r["sweep"] for r in m["retried"]] == ["scan_bandwidth"], m
 print("failures.json populated:", m["retried"][0]["error"])
 PY
 
-echo "== 2/6 spmv ladder: injected pallas failure -> demoted, correct"
+echo "== 2/8 spmv ladder: injected pallas failure -> demoted, correct"
 CME213_FAULTS="fail:spmv_scan.pallas-fused" python - <<'PY'
 from cme213_tpu.apps import spmv_scan as sp
 from cme213_tpu.core import trace
@@ -72,7 +80,7 @@ assert errs["rel_l2"] < 1e-4, errs
 print("demoted to", served["rung"], "rel_l2", errs["rel_l2"])
 PY
 
-echo "== 3/6 launcher: injected rank kill survived by --max-restarts 1"
+echo "== 3/8 launcher: injected rank kill survived by --max-restarts 1"
 CME213_FAULTS="rankkill:1:0" python -m cme213_tpu.dist.launch \
     --np 2 --max-restarts 1 --timeout 120 -- \
     python -c "import os; from cme213_tpu.core import faults; \
@@ -97,7 +105,7 @@ cat > "$OUT/params_gang.in" <<'EOF'
 100.0 25.0 0.0 50.0
 EOF
 
-echo "== 4/6 supervised gang: rankkill -> gang restart + epoch-commit resume"
+echo "== 4/8 supervised gang: rankkill -> gang restart + epoch-commit resume"
 # 1 process x 2 fake devices: real halo-exchange collectives in the rank,
 # real process death, real gang supervision — works on every backend.
 # Per-rank trace sinks feed step 6's CLI gate.
@@ -119,7 +127,7 @@ print(f"gang recovery OK (final commit: epoch {m['epoch']}, "
       f"step {m['step']})")
 PY
 
-echo "== 5/6 supervised gang across 2 REAL ranks (capability-gated)"
+echo "== 5/8 supervised gang across 2 REAL ranks (capability-gated)"
 set +e
 CME213_FAULTS="rankkill:1:1" JAX_PLATFORMS= \
 CME213_TRACE_FILE="$OUT/trace5-{rank}.jsonl" python -m cme213_tpu.dist.launch \
@@ -147,7 +155,7 @@ else
   echo "2-rank gang recovery OK"
 fi
 
-echo "== 6/6 trace CLI over the per-rank gang traces (ISSUE 4)"
+echo "== 6/8 trace CLI over the per-rank gang traces (ISSUE 4)"
 # step 4's files always exist; any unparseable line exits 2, a missing
 # commit span or gang phase exits 1 — either fails the gate
 python -m cme213_tpu trace summary "$OUT"/trace4-*.jsonl \
@@ -167,5 +175,56 @@ if ls "$OUT"/trace5-*.jsonl >/dev/null 2>&1; then
   python -m cme213_tpu trace merge --timeline "$OUT"/trace5-*.jsonl \
       > /dev/null
 fi
+
+echo "== 7/8 conformance gate: wrong: probe poison -> demotion (ISSUE 5)"
+# the first conformance probe of spmv_scan (the requested pallas-fused
+# rung) is perturbed; the gate must demote it, the next rung (blocked,
+# probe call 2, clean) serves, and the result still passes the f64 check
+CME213_FAULTS="wrong:spmv_scan:1" \
+CME213_TRACE_FILE="$OUT/trace7.jsonl" python - <<'PY'
+from cme213_tpu.apps import spmv_scan as sp
+from cme213_tpu.core import trace
+prob = sp.generate_problem(4096, 64, 63, iters=4, seed=0)
+out = sp.run_spmv_scan(prob, kernel="pallas-fused")
+served = trace.events("served")[-1]
+assert served["demoted"] and served["rung"] == "blocked", served
+failed = trace.events("rung-failed")[-1]
+assert failed["kind"] == "wrong_answer", failed
+assert trace.events("conformance-failed"), "no conformance-failed event"
+errs = sp.external_check(prob, out)
+assert errs["rel_l2"] < 1e-4, errs
+print("wrong-answer rung demoted; served", served["rung"],
+      "rel_l2", errs["rel_l2"])
+PY
+# the CLI gate the tier-1 workflow also runs: the event must be findable
+python -m cme213_tpu trace summary "$OUT/trace7.jsonl" \
+    --require conformance-failed
+if python -m cme213_tpu trace summary "$OUT/trace7.jsonl" \
+    --require no-such-event 2>/dev/null; then
+  echo "ERROR: --require must fail on a missing event" >&2
+  exit 1
+fi
+
+echo "== 8/8 admission: oom: -> chunk shrink, bitwise-equal completion"
+CME213_FAULTS="oom:heat_chunk:1" \
+CME213_TRACE_FILE="$OUT/trace8.jsonl" python - "$OUT" <<'PY'
+import os
+import sys
+import numpy as np
+from cme213_tpu.apps.heat2d import run_heat_checkpointed
+from cme213_tpu.config import SimParams
+from cme213_tpu.core import faults, trace
+p = SimParams(nx=24, ny=24, order=2, iters=8)
+out_f = run_heat_checkpointed(p, sys.argv[1] + "/oom_f.npz", every=4)
+shrunk = trace.events("chunk-shrunk")
+assert [(e["from_size"], e["to_size"]) for e in shrunk] == [(4, 2)], shrunk
+del os.environ["CME213_FAULTS"]  # the reference run must be un-faulted
+faults.reset()
+out_c = run_heat_checkpointed(p, sys.argv[1] + "/oom_c.npz", every=4)
+np.testing.assert_array_equal(out_f, out_c)
+print("oom chunk shrink 4->2; result bitwise-equal to un-faulted run")
+PY
+python -m cme213_tpu trace summary "$OUT/trace8.jsonl" \
+    --require chunk-shrunk
 
 echo "faultcheck OK"

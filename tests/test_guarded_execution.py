@@ -1,0 +1,480 @@
+"""Guarded execution (ISSUE 5): conformance gating + admission control.
+
+Two prongs, both exercised end-to-end under deterministic injected
+faults:
+
+- **Conformance gating** (`core/conformance.py`): a rung whose probe
+  diverges from the reference rung is demoted with WRONG_ANSWER before
+  it can serve a silently-wrong result; `wrong:<op>` clauses poison
+  exactly one probe so the gate is testable on CPU.  Verdicts cache
+  in-process and optionally on disk (`CME213_CONFORMANCE_CACHE`).
+- **Admission control** (`core/admission.py`): jitted computations are
+  preflighted against `CME213_MEMORY_BUDGET`; a runtime
+  RESOURCE_EXHAUSTED (`oom:<op>` clauses) halves the solve chunk /
+  pipeline tile and retries — bitwise-neutral by construction.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cme213_tpu.core import (FailureKind, admission, classify_failure,
+                             conformance, faults, metrics, trace,
+                             with_fallback)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    trace.clear_events()
+    conformance.reset()
+    yield
+    faults.reset()
+    conformance.reset()
+
+
+# ------------------------------------------------------------ fault clauses
+
+def test_wrong_and_oom_clause_parsing():
+    plan = faults.FaultPlan.parse("wrong:spmv_scan:2, oom:heat_chunk")
+    kinds = [(c.kind, c.op, c.nth) for c in plan.clauses]
+    assert kinds == [("wrong", "spmv_scan", 2), ("oom", "heat_chunk", 1)]
+
+
+def test_maybe_perturb_fires_on_nth_call_only():
+    with faults.injected("wrong:op:2"):
+        a = np.ones(8, np.float32)
+        out1 = faults.maybe_perturb("op", a)
+        np.testing.assert_array_equal(out1, a)      # call 1: clean
+        out2 = faults.maybe_perturb("op", a)        # call 2: perturbed
+        assert out2[0] != a[0] and np.isfinite(out2).all()
+        np.testing.assert_array_equal(out2[1:], a[1:])  # ONE element
+        np.testing.assert_array_equal(a, np.ones(8, np.float32))  # no mutation
+        out3 = faults.maybe_perturb("op", a)
+        np.testing.assert_array_equal(out3, a)      # call 3: clean again
+
+
+def test_wrong_and_oom_are_incarnation_gated(monkeypatch):
+    monkeypatch.setenv("CME213_INCARNATION", "1")
+    with faults.injected("wrong:op:1, oom:op:1"):
+        a = np.ones(4, np.float32)
+        np.testing.assert_array_equal(faults.maybe_perturb("op", a), a)
+        faults.maybe_oom("op")  # must not raise on a restarted incarnation
+
+
+def test_maybe_oom_raises_resource_classified():
+    with faults.injected("oom:op:1"):
+        with pytest.raises(faults.InjectedResourceExhausted) as ei:
+            faults.maybe_oom("op")
+    assert classify_failure(ei.value) is FailureKind.RESOURCE
+
+
+def test_real_resource_exhausted_message_classifies():
+    exc = RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying to "
+                       "allocate 17179869184 bytes.")
+    assert classify_failure(exc) is FailureKind.RESOURCE
+    # compile-time VMEM pressure stays COMPILE (a different kernel
+    # formulation can fix it; smaller chunks cannot)
+    assert (classify_failure(RuntimeError("Mosaic: vmem limit exceeded"))
+            is FailureKind.COMPILE)
+
+
+# ------------------------------------------------------- conformance core
+
+def test_conformance_check_pass_fail_and_events():
+    ref = np.arange(8, dtype=np.float32)
+    v = conformance.check("op", "good", "f32", lambda: ref.copy(),
+                          lambda: ref.copy())
+    assert v.ok and not v.cached and v.detail == "bitwise"
+    bad = ref.copy()
+    bad[3] += 1.0
+    v2 = conformance.check("op", "bad", "f32", lambda: bad,
+                           lambda: ref.copy())
+    assert not v2.ok
+    failed = trace.events("conformance-failed")
+    assert [(e["op"], e["rung"]) for e in failed] == [("op", "bad")]
+    probes = trace.events("conformance-probe")
+    assert [e["ok"] for e in probes] == [True, False]
+
+
+def test_conformance_declared_tolerance():
+    ref = np.ones(1000, np.float32)
+    near = ref * np.float32(1 + 1e-7)
+    assert not conformance.check("op", "r1", "f32", lambda: near,
+                                 lambda: ref.copy()).ok  # bitwise default
+    assert conformance.check("op", "r2", "f32", lambda: near,
+                             lambda: ref.copy(), rel_l2=1e-5).ok
+    far = ref * np.float32(1.5)
+    assert not conformance.check("op", "r3", "f32", lambda: far,
+                                 lambda: ref.copy(), rel_l2=1e-5).ok
+
+
+def test_conformance_nonfinite_candidate_fails():
+    ref = np.ones(4, np.float32)
+    bad = ref.copy()
+    bad[0] = np.nan
+    assert not conformance.check("op", "r", "f32", lambda: bad,
+                                 lambda: ref.copy(), rel_l2=1.0).ok
+
+
+def test_probe_cache_hit_and_miss():
+    calls = []
+
+    def candidate():
+        calls.append(1)
+        return np.ones(4, np.float32)
+
+    ref = lambda: np.ones(4, np.float32)  # noqa: E731
+    v1 = conformance.check("op", "r", "cls", candidate, ref)
+    v2 = conformance.check("op", "r", "cls", candidate, ref)
+    assert len(calls) == 1 and not v1.cached and v2.cached and v2.ok
+    # a different shape class is a different verdict: probe re-runs
+    conformance.check("op", "r", "other-cls", candidate, ref)
+    assert len(calls) == 2
+    conformance.reset()
+    conformance.check("op", "r", "cls", candidate, ref)
+    assert len(calls) == 3
+
+
+def test_disk_cache_round_trip(tmp_path, monkeypatch):
+    path = tmp_path / "verdicts.json"
+    monkeypatch.setenv(conformance.CACHE_ENV, str(path))
+    calls = []
+
+    def candidate():
+        calls.append(1)
+        return np.ones(4, np.float32)
+
+    ref = lambda: np.ones(4, np.float32)  # noqa: E731
+    conformance.check("op", "r", "cls", candidate, ref)
+    assert json.loads(path.read_text())["op|r|cls"]["ok"] is True
+    conformance.reset()  # a "new process": in-memory verdicts gone
+    v = conformance.check("op", "r", "cls", candidate, ref)
+    assert v.ok and v.cached and len(calls) == 1  # served from disk
+
+
+def test_with_fallback_gate_demotes_wrong_answer():
+    res = with_fallback("op", [("a", lambda: "a-val"), ("b", lambda: "b-val")],
+                        gate=lambda rung: rung != "a")
+    assert res.value == "b-val" and res.rung == "b"
+    assert [f.kind for f in res.failures] == [FailureKind.WRONG_ANSWER]
+    ev = trace.events("rung-failed")[-1]
+    assert ev["kind"] == "wrong_answer" and ev["error"] == "ConformanceFailed"
+
+
+def test_with_fallback_gate_all_rungs_rejected_raises():
+    from cme213_tpu.core import FrameworkError
+
+    with pytest.raises(FrameworkError, match="rungs"):
+        with_fallback("op", [("a", lambda: 1)], gate=lambda r: False)
+
+
+# ---------------------------------------------------------- admission core
+
+def test_parse_budget_suffixes():
+    assert admission.parse_budget("1024") == 1024
+    assert admission.parse_budget("4K") == 4096
+    assert admission.parse_budget("2m") == 2 << 20
+    assert admission.parse_budget("1.5G") == int(1.5 * (1 << 30))
+
+
+def test_preflight_against_fake_budget(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(a):
+        return a * 2.0
+
+    big = jnp.ones((1 << 14,), jnp.float32)  # 64 KiB in + 64 KiB out
+    monkeypatch.setenv(admission.BUDGET_ENV, "16K")
+    d = admission.preflight(f, big, op="toy")
+    assert not d.admitted and d.required_bytes > d.budget_bytes
+    ev = trace.events("admission-rejected")[-1]
+    assert ev["op"] == "toy" and ev["requested_bytes"] == d.required_bytes
+    monkeypatch.setenv(admission.BUDGET_ENV, "64M")
+    assert admission.preflight(f, big, op="toy").admitted
+
+
+def test_preflight_without_budget_is_pass_open(monkeypatch):
+    import jax
+
+    monkeypatch.delenv(admission.BUDGET_ENV, raising=False)
+    d = admission.preflight(jax.jit(lambda a: a + 1), np.ones(4, np.float32),
+                            op="toy")
+    # CPU backend reports no device memory: admission stays off
+    assert d.admitted and d.budget_bytes is None
+
+
+def test_admit_chunk_halves_until_fit():
+    seen = []
+
+    def pf(k):
+        seen.append(k)
+        return admission.Decision(k <= 4, k, 4, f"k={k}")
+
+    assert admission.admit_chunk("toy", 16, pf) == 4
+    assert seen == [16, 8, 4]
+    assert len(trace.events("chunk-shrunk")) == 2
+
+
+def test_admit_chunk_floor_still_over_budget_raises():
+    def pf(k):
+        return admission.Decision(False, k, 0, "never fits")
+
+    with pytest.raises(admission.AdmissionError):
+        admission.admit_chunk("toy", 8, pf, floor=2)
+
+
+# --------------------------------------------------- end-to-end: SpMV-scan
+
+def test_spmv_wrong_fault_demotes_and_matches_reference_bitwise():
+    """ISSUE-5 acceptance: CME213_FAULTS=wrong:spmv_scan:1 -> the
+    conformance gate demotes the poisoned rung and the served result is
+    bitwise-equal to the un-faulted reference(-rung) run."""
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(1024, 32, 31, iters=4, seed=0)
+    with faults.injected("wrong:spmv_scan:1"):
+        out = sp.run_spmv_scan(prob, kernel="blocked")
+    served = trace.events("served")[-1]
+    assert served["rung"] == "flat" and served["demoted"]
+    failed = trace.events("rung-failed")[-1]
+    assert failed["rung"] == "blocked" and failed["kind"] == "wrong_answer"
+    assert trace.events("conformance-failed")
+    faults.reset()
+    conformance.reset()
+    ref = sp.run_spmv_scan(prob, kernel="flat")
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_spmv_unfaulted_rungs_pass_their_probes():
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(1024, 32, 31, iters=4, seed=0)
+    out = sp.run_spmv_scan(prob, kernel="blocked")
+    served = trace.events("served")[-1]
+    assert served["rung"] == "blocked" and not served["demoted"]
+    assert not trace.events("conformance-failed")
+    # steady state: the verdict is cached, no further probes
+    n_probes = len(trace.events("conformance-probe"))
+    sp.run_spmv_scan(prob, kernel="blocked")
+    assert len(trace.events("conformance-probe")) == n_probes
+
+
+def test_spmv_checkpointed_oom_shrinks_chunk_bitwise_equal(tmp_path):
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(1024, 32, 31, iters=8, seed=0)
+    with faults.injected("oom:spmv_scan_chunk:1"):
+        out_f = sp.run_spmv_scan_checkpointed(
+            prob, str(tmp_path / "f.npz"), every=4)
+    ev = trace.events("chunk-shrunk")[-1]
+    assert (ev["from_size"], ev["to_size"]) == (4, 2)
+    faults.reset()
+    out_c = sp.run_spmv_scan_checkpointed(
+        prob, str(tmp_path / "c.npz"), every=4)
+    np.testing.assert_array_equal(out_f, out_c)
+
+
+# -------------------------------------------------------- end-to-end: heat
+
+def test_heat_checkpointed_oom_shrinks_chunk_bitwise_equal(tmp_path):
+    """ISSUE-5 acceptance: CME213_FAULTS=oom:heat_chunk:1 -> the
+    checkpointed solve shrinks its chunk, retries, and completes
+    bitwise-equal to the un-faulted run."""
+    from cme213_tpu.apps.heat2d import run_heat_checkpointed
+    from cme213_tpu.config import SimParams
+
+    p = SimParams(nx=24, ny=24, order=2, iters=8)
+    with faults.injected("oom:heat_chunk:1"):
+        out_f = run_heat_checkpointed(p, str(tmp_path / "f.npz"), every=4)
+    ev = trace.events("chunk-shrunk")[-1]
+    assert (ev["op"], ev["from_size"], ev["to_size"]) == ("heat2d", 4, 2)
+    faults.reset()
+    out_c = run_heat_checkpointed(p, str(tmp_path / "c.npz"), every=4)
+    np.testing.assert_array_equal(out_f, out_c)
+
+
+def test_heat_resilient_gate_demotes_diverging_orders():
+    """On this backend the order-8 Pallas pipeline rungs bitwise-diverge
+    from the XLA reference (FMA contraction on the roll formulation's
+    concat seams — docs/resilience.md "Guarded execution"); the gate must
+    keep them out of the serving ladder and the served result must be
+    bitwise-equal to run_heat.  Order 2 probes clean and serves the
+    pipeline rung."""
+    import jax.numpy as jnp
+
+    from cme213_tpu.config import SimParams
+    from cme213_tpu.grid import make_initial_grid
+    from cme213_tpu.ops import run_heat
+    from cme213_tpu.ops.stencil_pipeline import run_heat_resilient
+
+    for order, expect_serving in ((2, "pipeline"), (8, "xla")):
+        p = SimParams(nx=40, ny=40, order=order, iters=4)
+        u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+        res = run_heat_resilient(jnp.array(u0), 4, order, p.xcfl, p.ycfl,
+                                 p.bc, k=1, interpret=True)
+        assert res.rung == expect_serving, (order, res.rung)
+        ref = np.asarray(run_heat(jnp.array(u0), 4, order, p.xcfl, p.ycfl))
+        np.testing.assert_array_equal(np.asarray(res.value), ref)
+    assert all(f.kind is FailureKind.WRONG_ANSWER
+               for f in res.failures)  # the order-8 demotions
+
+
+def test_heat_resilient_oom_shrinks_tile():
+    import jax.numpy as jnp
+
+    from cme213_tpu.config import SimParams
+    from cme213_tpu.grid import make_initial_grid
+    from cme213_tpu.ops import run_heat
+    from cme213_tpu.ops.stencil_pipeline import run_heat_resilient
+
+    p = SimParams(nx=40, ny=40, order=2, iters=4)
+    u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+    with faults.injected("oom:heat.pipeline:1"):
+        res = run_heat_resilient(jnp.array(u0), 4, 2, p.xcfl, p.ycfl, p.bc,
+                                 k=1, tile_y=32, interpret=True)
+    assert res.rung == "pipeline" and not res.demoted
+    ev = trace.events("chunk-shrunk")[-1]
+    assert ev["op"] == "heat.pipeline"
+    assert (ev["from_size"], ev["to_size"]) == (32, 16)
+    ref = np.asarray(run_heat(jnp.array(u0), 4, 2, p.xcfl, p.ycfl))
+    np.testing.assert_array_equal(np.asarray(res.value), ref)
+
+
+def test_pick_pipeline_tile_respects_memory_budget(monkeypatch):
+    from cme213_tpu.ops.stencil_pipeline import pick_pipeline_tile
+
+    unclamped = pick_pipeline_tile(4008, 1, 8, target=256, width=1024)
+    monkeypatch.setenv(admission.BUDGET_ENV, "1M")
+    clamped = pick_pipeline_tile(4008, 1, 8, target=256, width=1024)
+    assert clamped < unclamped
+    # still a multiple of the halo quantum, still at least one quantum
+    assert clamped % 8 == 0 and clamped >= 8
+    W = 1024
+    assert 2 * 4 * W * (2 * clamped + 2 * 8) <= 1 << 20
+
+
+# -------------------------------------------------- end-to-end: dist paths
+
+def test_dist_scan_wrong_fault_demotes_ring_to_gather():
+    from cme213_tpu.dist import make_mesh_1d
+    from cme213_tpu.dist.scan import make_iterated_sharded_scan_gated
+
+    _, mode = make_iterated_sharded_scan_gated(make_mesh_1d(4))
+    assert mode == "ring"
+    conformance.reset()
+    trace.clear_events()
+    with faults.injected("wrong:dist_scan:1"):
+        _, mode = make_iterated_sharded_scan_gated(make_mesh_1d(4))
+    assert mode == "gather"
+    ev = trace.events("rung-failed")[-1]
+    assert ev["op"] == "dist_scan" and ev["rung"] == "ring"
+    assert ev["kind"] == "wrong_answer"
+
+
+def test_dist_heat_gate_demotes_multistep_at_order8():
+    """The k>1 communication-avoiding path bitwise-diverges from the
+    exchange-every-step path at order 8 on this backend; the gated solve
+    must serve the k=1 result instead."""
+    from cme213_tpu.config import SimParams
+    from cme213_tpu.dist import make_mesh_1d
+    from cme213_tpu.dist.heat import run_distributed_heat
+
+    p = SimParams(nx=64, ny=64, order=8, iters=8)
+    mesh = make_mesh_1d(4)
+    base = run_distributed_heat(p, mesh, overlap=False, conformance=False)
+    multi = run_distributed_heat(p, mesh, overlap=False,
+                                 steps_per_exchange=4)
+    assert any(e["rung"] == "xla-k4" for e in trace.events("rung-failed"))
+    np.testing.assert_array_equal(multi, base)
+
+
+def test_dist_heat_gated_pallas_serves_conformant_kernel():
+    """The Pallas local kernel agrees bitwise with the dist XLA rung (its
+    actual contract); the gated path must serve it without demotion and
+    match the ungated XLA solve."""
+    from cme213_tpu.config import SimParams
+    from cme213_tpu.dist import make_mesh_1d
+    from cme213_tpu.dist.heat import run_distributed_heat
+
+    p = SimParams(nx=40, ny=48, order=8, iters=4)
+    mesh = make_mesh_1d(4)
+    out = run_distributed_heat(p, mesh, local_kernel="pallas")
+    assert not [e for e in trace.events("rung-failed")
+                if e["op"] == "dist_heat"]
+    ref = run_distributed_heat(p, mesh, overlap=False, conformance=False)
+    np.testing.assert_array_equal(out, ref)
+
+
+# --------------------------------------------------------------- trace CLI
+
+def test_trace_summary_reports_conformance_and_admission(tmp_path, capsys):
+    from cme213_tpu import trace_cli
+
+    recs = [
+        {"event": "conformance-probe", "t": 1.0, "op": "spmv_scan",
+         "rung": "blocked", "shape_class": "float32", "ok": False,
+         "ms": 3.2},
+        {"event": "conformance-failed", "t": 1.1, "op": "spmv_scan",
+         "rung": "blocked", "shape_class": "float32",
+         "detail": "rel_l2=2.5e-01 (tol 1e-05)"},
+        {"event": "admission-rejected", "t": 1.2, "op": "heat2d",
+         "requested_bytes": 2048, "budget_bytes": 1024,
+         "detail": "footprint 2048 > budget 1024"},
+        {"event": "chunk-shrunk", "t": 1.3, "op": "heat2d", "from_size": 4,
+         "to_size": 2, "reason": "InjectedResourceExhausted"},
+    ]
+    p = tmp_path / "t.jsonl"
+    with open(p, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    assert trace_cli.main(["summary", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "conformance: 1 probe(s), 0 passed, 1 failed" in out
+    assert "spmv_scan.blocked: FAIL x1" in out
+    assert "admission: 1 rejected, 1 chunk(s)/tile(s) shrunk" in out
+    assert "heat2d 4 -> 2" in out
+    # --require accepts event names (the faultcheck conformance gate)
+    assert trace_cli.main(["summary", str(p),
+                           "--require", "conformance-failed"]) == 0
+    assert trace_cli.main(["summary", str(p),
+                           "--require", "epoch-commit"]) == 1
+
+
+def test_guarded_events_validate_against_schema():
+    ref = np.ones(4, np.float32)
+    bad = ref + 1
+    conformance.check("op", "r", "cls", lambda: bad, lambda: ref.copy())
+    with faults.injected("oom:op:1"):
+        with pytest.raises(faults.InjectedResourceExhausted):
+            faults.maybe_oom("op")
+    with faults.injected("wrong:op:1"):
+        faults.maybe_perturb("op", np.ones(3, np.float32))
+
+    def pf(k):
+        return admission.Decision(k <= 1, k, 1, "d")
+
+    admission.admit_chunk("toy", 2, pf)
+    for rec in trace.events():
+        assert trace.validate_record(rec) == [], rec
+
+
+# ------------------------------------------------------------ matrix market
+
+def test_truncated_mtx_to_zero_entries_is_warning_free(tmp_path):
+    """np.loadtxt's empty-input UserWarning must not leak: truncation to
+    zero entries flows through the DataValidationError path instead."""
+    import warnings
+
+    from cme213_tpu.apps.matrix_market import read_matrix_market
+    from cme213_tpu.core import DataValidationError
+
+    p = tmp_path / "t.mtx"
+    p.write_text("%%MatrixMarket matrix coordinate real general\n3 3 2\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning fails the test
+        with pytest.raises(DataValidationError, match="entry-count"):
+            read_matrix_market(str(p))
