@@ -6,7 +6,14 @@ Mirrors the reference's measurement: hot iteration loop, effective bandwidth
 shared-memory order-8 kernel at 4000² on a GTX 580 = **23.97 GB/s**.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} with
-roofline context (``pct_hbm_peak``, ``gflops``) and per-kernel results.
+roofline context (``pct_hbm_peak``, ``pct_peak``, ``bound``, ``gflops``)
+and per-kernel results.  Attribution comes from the centralized device-peak
+registry + cost models (``cme213_tpu.core.roofline``): every per-kernel row
+carries ``pct_peak`` (achieved/peak HBM bandwidth for the device it ran on)
+and a memory-vs-compute ``bound`` verdict.  Per-rung failures are recorded
+as structured ``kernel-failure`` events in the trace sink
+(``CME213_TRACE_FILE``), so a capture's failure ladder is analyzable with
+``python -m cme213_tpu trace`` instead of by grepping stderr tails.
 
 Every candidate kernel runs in its OWN child process (``--run-measurement
 --kernel=NAME``) with its own device preflight: a kernel that faults the
@@ -29,7 +36,11 @@ import subprocess
 import sys
 
 BASELINE_GBS = 23.97  # hw2 shared-memory order-8 4000² float (BASELINE.md)
-HBM_PEAK_GBS = 819.0  # TPU v5e HBM bandwidth (the chip bench runs on)
+# TPU v5e HBM bandwidth (the chip bench runs on).  Must equal
+# core/roofline.BUILTIN_PEAKS["tpu-v5e"].gbs (pinned by a tier-1 test);
+# kept a literal because cme213_tpu imports must stay inside functions
+# here — children apply JAX_PLATFORMS before jax ever loads.
+HBM_PEAK_GBS = 819.0
 
 _CHILD_FLAG = "--run-measurement"
 _PREFLIGHT_EXIT = 42
@@ -157,13 +168,11 @@ def measure_one(name: str, dtype_name: str) -> dict:
 
     from cme213_tpu.config import SimParams
     from cme213_tpu.grid import make_initial_grid
-    from cme213_tpu.ops.stencil import flops_per_point
 
     nx = ny = 4000
     order = 8
     params = SimParams(nx=nx, ny=ny, order=order, iters=1000)
     dtype = {"f32": jnp.float32, "f64": jnp.float64}[dtype_name]
-    elem = np.dtype({"f32": np.float32, "f64": np.float64}[dtype_name]).itemsize
     # Host copy: the heat loops donate their input buffer, and device_put of
     # an already-committed device array is a no-op returning the same buffer
     # — which the first donated call would delete out from under us.
@@ -234,16 +243,25 @@ def measure_one(name: str, dtype_name: str) -> dict:
         return {"kernel": name, "ok": False,
                 "error": f"{type(e).__name__}: {e}"}
 
+    from cme213_tpu.core import roofline
+
     per_iter = elapsed / iters
-    bytes_per_iter = 2 * elem * nx * ny
+    cost = roofline.heat_cost(ny, nx, order=order, iters=1,
+                              dtype=dtype_name)
+    gbs = round(cost.nbytes / per_iter / 1e9, 2)
+    gflops = round(cost.flops / per_iter / 1e9, 2)
+    att = roofline.attribute(gbs, gflops)
     return {
         "kernel": name, "ok": True, "iters": iters,
         "variant": variant_label,
         "platform": dev.platform,
+        "device_kind": att["device"],
         "dtype": dtype_name,
         "ms_per_iter": round(per_iter * 1e3, 4),
-        "gbs": round(bytes_per_iter / per_iter / 1e9, 2),
-        "gflops": round(flops_per_point(order) * nx * ny / per_iter / 1e9, 2),
+        "gbs": gbs,
+        "gflops": gflops,
+        "pct_peak": att["pct_peak"],
+        "bound": att["bound"],
     }
 
 
@@ -307,6 +325,14 @@ def run_children(dtype_name: str, budget_s: float = 2700.0) -> list[dict]:
                        and "unreachable" in row.get("error", ""))
         dead_streak = dead_streak + 1 if unreachable else 0
         rows.append(row)
+        if not row.get("ok"):
+            # structured form of the per-rung "pallas: failed (...)" tail
+            # lines (BENCH_r02): lands in the CME213_TRACE_FILE sink so
+            # TPU captures are analyzable with the trace CLI
+            from cme213_tpu.core import trace
+
+            trace.record_event("kernel-failure", op="heat2d", kernel=name,
+                               error=str(row.get("error", ""))[:500])
         detail = (f"{row['ms_per_iter']} ms/iter, {row['gbs']} GB/s eff, "
                   f"{row['gflops']} GF/s" if row.get("ok")
                   else f"failed ({row.get('error')})")
@@ -352,9 +378,15 @@ def run_spmv_bench() -> None:
     process (the sweep already classifies per-kernel failures as rows)."""
     _apply_platform_env()
     from cme213_tpu.bench.sweeps import spmv_scan_sweep
+    from cme213_tpu.core import trace
 
     rows = spmv_scan_sweep()
     ok = [r for r in rows if not r.get("error") and r["gbs"] > 0]
+    for r in rows:
+        if r.get("error"):
+            trace.record_event("kernel-failure", op="spmv_scan",
+                               kernel=r.get("kernel", "?"),
+                               error=str(r["error"])[:500])
     if not ok:
         print(json.dumps({
             "metric": "spmv_scan iterated segmented-scan effective "
@@ -368,6 +400,7 @@ def run_spmv_bench() -> None:
                   f"at n={n_max} (best kernel: {best['kernel']})",
         "value": best["gbs"], "unit": "GB/s",
         "pct_hbm_peak": round(100 * best["gbs"] / HBM_PEAK_GBS, 1),
+        "pct_peak": best.get("pct_peak"), "bound": best.get("bound"),
         "kernels": rows,
     }))
 
@@ -391,6 +424,19 @@ def main() -> None:
                        if a.startswith("--dtype=")), "f32")
     rows = run_children(dtype_name)
     ok = [r for r in rows if r.get("ok")]
+    # rows from older children (or fakes) may predate in-child
+    # attribution: fill pct_peak/bound from the registry, keyed by the
+    # row's own platform — no jax needed in the parent
+    from cme213_tpu.core import roofline
+
+    for r in ok:
+        if "pct_peak" not in r:
+            device = r.get("device_kind") or (
+                "tpu-v5e" if r.get("platform") == "tpu"
+                else r.get("platform"))
+            att = roofline.attribute(r.get("gbs", 0.0),
+                                     r.get("gflops", 0.0), device=device)
+            r["pct_peak"], r["bound"] = att["pct_peak"], att["bound"]
     best = max(ok, key=lambda r: r["gbs"]) if ok else None
     if best is None:
         # value stays 0 — no live measurement happened — but point at the
@@ -411,6 +457,8 @@ def main() -> None:
         "unit": "GB/s",
         "vs_baseline": round(best["gbs"] / BASELINE_GBS, 3),
         "pct_hbm_peak": round(100 * best["gbs"] / HBM_PEAK_GBS, 1),
+        "pct_peak": best.get("pct_peak"),
+        "bound": best.get("bound"),
         "gflops": best["gflops"],
         "kernels": rows,
     }))

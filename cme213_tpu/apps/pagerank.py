@@ -62,13 +62,16 @@ def run_pagerank(graph: Graph, nr_iterations: int, timer: PhaseTimer | None = No
 
 
 def bytes_moved(graph: Graph, nr_iterations: int) -> int:
-    """Exact byte accounting for bandwidth reports, as instrumented in the
-    reference sweep harness (``hw/hw1/programming/analysis/pagerank.cu:47-62``):
-    per iteration, each edge reads a 4B neighbor id + 4B rank + 4B inv_deg,
-    each node reads 2×4B offsets and writes a 4B rank."""
-    n, e = graph.num_nodes, graph.edges.shape[0]
-    per_iter = e * 12 + n * 12
-    return per_iter * nr_iterations
+    """Exact byte accounting for bandwidth reports — delegates to the
+    centralized cost model (``core/roofline.pagerank_cost``), as
+    instrumented in the reference sweep harness
+    (``hw/hw1/programming/analysis/pagerank.cu:47-62``): per iteration,
+    each edge reads a 4B neighbor id + 4B rank + 4B inv_deg, each node
+    reads 2×4B offsets and writes a 4B rank."""
+    from ..core.roofline import pagerank_cost
+
+    return pagerank_cost(graph.num_nodes, graph.edges.shape[0],
+                         nr_iterations).nbytes
 
 
 def main(num_nodes: int = 1 << 21, avg_edges: int = 8, iterations: int = 20,

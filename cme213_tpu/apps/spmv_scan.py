@@ -205,16 +205,18 @@ def _iterate_pallas_unfused(a, xx, flags, iters: int, interpret: bool):
 
 
 def bytes_moved(n: int, iters: int, elem: int = 4) -> int:
-    """Exact byte accounting for bandwidth reports, as instrumented in the
-    reference sweep harness (same discipline as ``apps/pagerank.py:
-    bytes_moved``): per iteration the single-pass form reads the value
-    vector, the gathered ``xx`` vector, and the int32 head flags, and
-    writes the value vector — ``(3·elem + 4)·n`` bytes.  Multi-sweep
-    kernels move more than this; quoting all kernels against the same
-    useful-byte count is what makes the GB/s column comparable (the
-    "effective bandwidth" convention of ``bench.py``)."""
-    per_iter = n * (3 * elem + 4)
-    return per_iter * iters
+    """Exact byte accounting for bandwidth reports — delegates to the
+    centralized cost model (``core/roofline.spmv_scan_cost``): per
+    iteration the single-pass form reads the value vector, the gathered
+    ``xx`` vector, and the int32 head flags, and writes the value vector
+    — ``(3·elem + 4)·n`` bytes.  Multi-sweep kernels move more than
+    this; quoting all kernels against the same useful-byte count is what
+    makes the GB/s column comparable (the "effective bandwidth"
+    convention of ``bench.py``)."""
+    from ..core.roofline import spmv_scan_cost
+
+    dtype = {1: "u8", 2: "f16", 4: "f32", 8: "f64"}[elem]
+    return spmv_scan_cost(n, iters, dtype=dtype).nbytes
 
 
 #: demotion ladder per requested kernel — Pallas rungs degrade to the
@@ -359,12 +361,15 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
     before the jitted loop launches, so the healthy path times
     identically.
     """
-    from ..core import check_op, span, with_fallback
+    from ..core import check_op, roofline, span, with_fallback
 
     prob.validate()
     xx = jnp.asarray(prob.xx, dtype)
     flags = head_flags_from_starts(jnp.asarray(prob.s[:-1]), prob.n)
     timer = timer or PhaseTimer()
+
+    shape_class = f"n{prob.n}/i{prob.iters}"
+    cost = roofline.spmv_scan_cost(prob.n, prob.iters, dtype=dtype)
 
     def attempt(rung: str):
         def thunk():
@@ -377,13 +382,16 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
             # timed only kernel execution between cudaEvents); the named
             # barrier forces compile/runtime failures to surface HERE,
             # attributed to the rung, before the timed phase opens —
-            # spans split compile from run time per rung, so trace
-            # summaries separate the two the way the reference's warmup
-            # discipline did implicitly
-            with span("spmv_scan.compile", kernel=rung):
+            # spans split compile from run time per rung (feeding the
+            # per-shape-class compile.ms/run.ms histograms and the
+            # retrace detector), so trace summaries separate the two the
+            # way the reference's warmup discipline did implicitly
+            with span("spmv_scan.compile", kernel=rung,
+                      shape_class=shape_class):
                 check_op(f"spmv_scan.{rung}", runner(jnp.zeros_like(a)))
             with span("spmv_scan.run", kernel=rung, n=prob.n,
-                      iters=prob.iters):
+                      iters=prob.iters, shape_class=shape_class) as sp:
+                sp.roofline(cost.nbytes, cost.flops)
                 with timer.phase("spmv_scan") as ph:
                     out = runner(a)
                     ph.block(out)

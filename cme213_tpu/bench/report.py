@@ -61,7 +61,9 @@ def _bench_section(path: str, label: str) -> list[str]:
     lines.append(f"- **value**: {doc.get('value')} {doc.get('unit')}"
                  f" — {doc.get('vs_baseline')}× the GTX-580 baseline"
                  + (f", {doc.get('pct_hbm_peak')}% of HBM peak"
-                    if doc.get("pct_hbm_peak") is not None else ""))
+                    if doc.get("pct_hbm_peak") is not None else "")
+                 + (f", {doc.get('bound')}-bound"
+                    if doc.get("bound") else ""))
     kernels = doc.get("kernels")
     if kernels:
         lines += ["", _md_table(kernels)]
@@ -115,6 +117,22 @@ def generate(results_dir: str) -> str:
                           "the Pallas interpreter, ~40-80× slower than "
                           "the compiled kernel.", ""]
             lines += [_md_table(rows)]
+    # regression-gate verdict (bench/regress.py --json), when banked
+    regress = os.path.join(results_dir, "regress.json")
+    if os.path.isfile(regress):
+        try:
+            with open(regress) as f:
+                verdict = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            verdict = None
+        if verdict:
+            lines += [
+                "## Regression gate", "",
+                f"- **verdict**: {verdict.get('verdict')} "
+                f"(threshold {verdict.get('threshold')})", ""]
+            if verdict.get("regressions"):
+                lines += [_md_table(verdict["regressions"])]
+
     smoke = os.path.join(results_dir, "smoke_tpu.txt")
     if os.path.isfile(smoke):
         with open(smoke) as f:

@@ -21,6 +21,13 @@ histograms — ``core/metrics.py``) is attached to that sweep's row set in
 ``<out>/metrics.json``, keyed by sweep name.  The deltas ride in a
 sidecar instead of extra CSV columns so the banked-CSV comparators and
 the capture layer's shell parsers keep seeing the schema they pin.
+
+Profiling: set ``CME213_PROFILE_DIR=/path`` to wrap the whole run in
+``jax.profiler.trace`` (the XPlane kernel-level profile, viewable in
+TensorBoard/Perfetto) and to drop a ``device_memory_profile`` snapshot
+after each sweep — recorded as structured ``device-memory`` trace
+events, so memory growth across sweeps is analyzable with the trace
+CLI.  Profiling failures are warnings, never sweep failures.
 """
 
 from __future__ import annotations
@@ -124,40 +131,79 @@ def main(argv=None) -> int:
             return 2
     from ..core import faults, metrics, trace
 
+    profile_dir = os.environ.get("CME213_PROFILE_DIR")
+    profiling = False
+    if profile_dir:
+        try:
+            import jax
+
+            os.makedirs(profile_dir, exist_ok=True)
+            jax.profiler.start_trace(profile_dir)
+            profiling = True
+        except Exception as e:  # noqa: BLE001 — profiling is best-effort
+            print(f"CME213_PROFILE_DIR: profiler unavailable "
+                  f"({type(e).__name__}: {e})", file=sys.stderr)
+
+    def _memory_snapshot(name: str) -> None:
+        """Per-sweep device-memory pprof snapshot + structured event."""
+        if not profiling:
+            return
+        try:
+            import jax
+
+            blob = jax.profiler.device_memory_profile()
+            path = os.path.join(profile_dir, f"memory_{name}.prof")
+            with open(path, "wb") as f:
+                f.write(blob)
+            trace.record_event("device-memory", path=path,
+                               bytes=len(blob))
+        except Exception:  # noqa: BLE001 — never fail a sweep over this
+            pass
+
     failed, retried = [], []
     sweep_metrics: dict[str, dict] = {}
-    for fname, job in jobs:
-        if only is not None and fname[:-len(".csv")] not in only:
-            continue
-        name = fname[:-len(".csv")]
-        path = os.path.join(args.out, fname)
-        rows = None
-        before = metrics.snapshot()
-        t0 = time.perf_counter()
-        for attempt in (1, 2):  # one retry: a flake can't zero the capture
+    try:
+        for fname, job in jobs:
+            if only is not None and fname[:-len(".csv")] not in only:
+                continue
+            name = fname[:-len(".csv")]
+            path = os.path.join(args.out, fname)
+            rows = None
+            before = metrics.snapshot()
+            t0 = time.perf_counter()
+            for attempt in (1, 2):  # one retry: a flake can't zero the capture
+                try:
+                    faults.maybe_fail(f"sweep.{name}")
+                    rows = job()
+                    break
+                except Exception as e:
+                    rec = {"sweep": name, "attempt": attempt,
+                           "error": type(e).__name__, "message": str(e)[:500]}
+                    print(f"{fname}: FAILED attempt {attempt}/2 "
+                          f"({type(e).__name__}: {e})", file=sys.stderr)
+                    (retried if attempt == 1 else failed).append(rec)
+                    trace.record_event("sweep-failed", sweep=name,
+                                       attempt=attempt,
+                                       error=type(e).__name__)
+            if rows is None:
+                continue
+            ms = round((time.perf_counter() - t0) * 1e3, 1)
+            trace.record_event("sweep-complete", sweep=name, rows=len(rows),
+                               ms=ms)
+            _memory_snapshot(name)
+            sweep_metrics[name] = {"rows": len(rows), "ms": ms,
+                                   "metrics": metrics.delta(
+                                       before, metrics.snapshot())}
+            sweeps.write_csv(rows, path)
+            print(f"{path}: {len(rows)} rows")
+    finally:
+        if profiling:
             try:
-                faults.maybe_fail(f"sweep.{name}")
-                rows = job()
-                break
-            except Exception as e:
-                rec = {"sweep": name, "attempt": attempt,
-                       "error": type(e).__name__, "message": str(e)[:500]}
-                print(f"{fname}: FAILED attempt {attempt}/2 "
-                      f"({type(e).__name__}: {e})", file=sys.stderr)
-                (retried if attempt == 1 else failed).append(rec)
-                trace.record_event("sweep-failed", sweep=name,
-                                   attempt=attempt,
-                                   error=type(e).__name__)
-        if rows is None:
-            continue
-        ms = round((time.perf_counter() - t0) * 1e3, 1)
-        trace.record_event("sweep-complete", sweep=name, rows=len(rows),
-                           ms=ms)
-        sweep_metrics[name] = {"rows": len(rows), "ms": ms,
-                               "metrics": metrics.delta(before,
-                                                        metrics.snapshot())}
-        sweeps.write_csv(rows, path)
-        print(f"{path}: {len(rows)} rows")
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
     manifest = {"failed": failed, "retried": retried}
     with open(os.path.join(args.out, "failures.json"), "w") as f:
         json.dump(manifest, f, indent=2)

@@ -6,7 +6,7 @@ from .resilience import (FailureKind, FallbackResult, NonFiniteError,
                          with_fallback)
 from .trace import (EVENT_SCHEMA, clear_events, events, flush_sink,
                     record_event, span, validate_record)
-from . import admission, conformance, metrics
+from . import admission, conformance, metrics, roofline
 
 __all__ = [
     "PhaseTimer",
@@ -34,4 +34,5 @@ __all__ = [
     "admission",
     "conformance",
     "metrics",
+    "roofline",
 ]

@@ -90,9 +90,13 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "checkpoint-quarantine": ("path", "quarantined_to", "error", "message"),
     "numeric-abort": ("op", "step", "retries"),
     "checkpoint-rollback": ("op", "resumed_step", "retries"),
-    # bench harness (bench/run_all.py)
+    # bench harness (bench/run_all.py, bench.py)
     "sweep-failed": ("sweep", "attempt", "error"),
     "sweep-complete": ("sweep", "rows", "ms"),
+    "kernel-failure": ("op", "kernel", "error"),
+    "device-memory": ("path", "bytes"),
+    # compile/run split (this module; ROADMAP item 5's measurement half)
+    "compile-retrace": ("op", "shape_class", "count"),
     # distributed commits (dist/ckpt.py)
     "epoch-commit": ("epoch", "step", "world", "shards", "ms"),
     "commit-invalid": ("candidate", "error", "message"),
@@ -247,11 +251,13 @@ def events(event: str | None = None) -> list[dict]:
 
 
 def clear_events() -> None:
-    """Drop recorded events and re-read the ring-buffer cap env."""
+    """Drop recorded events (and the retrace detector's compile counts)
+    and re-read the ring-buffer cap env."""
     global _EVENTS, _BUFFER_CONFIGURED
     with _LOCK:
         _EVENTS = deque()
         _BUFFER_CONFIGURED = False
+        _COMPILE_COUNTS.clear()
 
 
 # ------------------------------------------------------------------ spans
@@ -265,16 +271,25 @@ class SpanHandle:
     """Yielded by ``span``: ``.block(*arrays)`` registers device arrays to
     ``jax.block_until_ready`` before the span's clock stops — async device
     work is attributed to the span that launched it, like the reference's
-    ``cudaEventSynchronize`` before ``stop_timer``."""
+    ``cudaEventSynchronize`` before ``stop_timer``.  ``.roofline(nbytes,
+    flops)`` declares the op's cost-model traffic so the ``span-end``
+    record carries ``achieved_gbs``/``pct_peak``/``bound`` computed from
+    the measured duration (``core/roofline.py``)."""
 
-    __slots__ = ("_blocked",)
+    __slots__ = ("_blocked", "_roofline")
 
     def __init__(self) -> None:
         self._blocked: list = []
+        self._roofline: tuple | None = None
 
     def block(self, *arrays) -> None:
         for a in arrays:
             self._blocked.append(a)
+
+    def roofline(self, nbytes: float, flops: float = 0.0) -> None:
+        """Declare this span's useful traffic (bytes moved, flops) so its
+        end record gains roofline attribution once the duration is known."""
+        self._roofline = (float(nbytes), float(flops))
 
 
 def current_span_id() -> str | None:
@@ -320,10 +335,67 @@ def span(name: str, **tags):
         end = dict(span=name, id=sid, parent=parent, ms=ms, **tags)
         if err is not None:
             end["error"] = err
+        if handle._roofline is not None and err is None and ms > 0:
+            try:
+                from . import roofline
+
+                nbytes, flops = handle._roofline
+                gbs = nbytes / 1e9 / (ms / 1e3)
+                att = roofline.attribute(gbs, flops / 1e9 / (ms / 1e3))
+                end["achieved_gbs"] = round(gbs, 3)
+                if att["pct_peak"] is not None:
+                    end["pct_peak"] = att["pct_peak"]
+                    end["bound"] = att["bound"]
+            except Exception:  # noqa: BLE001 — attribution never kills work
+                pass
         record_event("span-end", **end)
         from . import metrics
 
         metrics.histogram(f"span.{name}.ms").observe(ms)
+        if err is None:
+            _note_compile_run(name, tags.get("shape_class"), ms)
+
+
+# --------------------------------------------------- compile/run split
+
+#: (op, shape_class) -> completed ``<op>.compile`` span count — the
+#: retrace detector's state (ROADMAP item 5: heterogeneous traffic must
+#: not re-trace known shape classes).  Reset by ``clear_events``.
+_COMPILE_COUNTS: dict[tuple, int] = {}
+
+
+def compile_counts() -> dict[tuple, int]:
+    """Snapshot of per-(op, shape_class) compile counts this process."""
+    with _LOCK:
+        return dict(_COMPILE_COUNTS)
+
+
+def _note_compile_run(name: str, shape_class, ms: float) -> None:
+    """Feed per-(op, shape-class) ``compile.ms``/``run.ms`` histograms
+    from ``<op>.compile``/``<op>.run`` spans, and fire the retrace
+    detector: a shape class whose compile span completes more than once
+    in a process re-entered the trace/compile path — the retracing cost
+    ROADMAP item 5's compile-cache layer will have to kill — so it emits
+    a ``compile-retrace`` event and bumps the ``compile.retraces``
+    counter.  Errored spans are excluded upstream (a rung that failed to
+    compile is a demotion, not a retrace)."""
+    if shape_class is None:
+        return
+    from . import metrics
+
+    if name.endswith(".compile"):
+        op = name[: -len(".compile")]
+        metrics.histogram(f"compile.{op}.{shape_class}.ms").observe(ms)
+        with _LOCK:
+            n = _COMPILE_COUNTS[(op, shape_class)] = (
+                _COMPILE_COUNTS.get((op, shape_class), 0) + 1)
+        if n > 1:
+            metrics.counter("compile.retraces").inc()
+            record_event("compile-retrace", op=op,
+                         shape_class=shape_class, count=n)
+    elif name.endswith(".run"):
+        op = name[: -len(".run")]
+        metrics.histogram(f"run.{op}.{shape_class}.ms").observe(ms)
 
 
 @contextmanager

@@ -95,8 +95,9 @@ WORKLOADS: dict[str, Workload] = {
         # not a reference workload: the offline analysis pass over the
         # telemetry sinks every workload above writes (SURVEY §5's
         # spreadsheet step, made a first-class tool)
-        Workload("trace", "telemetry", "summary | timeline | merge over "
-                 "CME213_TRACE_FILE JSON-lines traces", _trace),
+        Workload("trace", "telemetry", "summary | timeline | merge | "
+                 "export (Perfetto) | regress over CME213_TRACE_FILE "
+                 "JSON-lines traces and bench artifacts", _trace),
     )
 }
 

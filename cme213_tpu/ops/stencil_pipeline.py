@@ -342,16 +342,26 @@ def run_heat_resilient(u, iters: int, order: int, xcfl, ycfl,
     timer = timer or PhaseTimer()
     u_host = jax.device_get(u)  # rungs donate; each attempt re-uploads
 
+    shape_class = f"{gy}x{gx}/order{order}/k{k}"
+    from ..core.roofline import heat_cost
+
+    cost = heat_cost(gy, gx, order=order, iters=iters, dtype=u_host.dtype)
+
     def timed(rung, runner_at_tile, shrinkable=True):
         # runner_at_tile(ty)(v): the tile knob stays adjustable so a
         # RESOURCE failure can halve it and retry within the rung
         def attempt(ty_cur):
             runner = runner_at_tile(ty_cur)
             maybe_oom(f"heat.{rung}")
-            # compile vs run split per rung, like spmv_scan's dispatch
-            with span("heat.compile", kernel=rung):
+            # compile vs run split per rung, like spmv_scan's dispatch —
+            # both spans feed the per-shape-class histograms + retrace
+            # detector, the run span carries roofline attribution
+            with span("heat.compile", kernel=rung,
+                      shape_class=shape_class):
                 check_op(f"heat.{rung}", runner(jnp.array(u_host)))
-            with span("heat.run", kernel=rung, size=gy, iters=iters):
+            with span("heat.run", kernel=rung, size=gy, iters=iters,
+                      shape_class=shape_class) as sp:
+                sp.roofline(cost.nbytes, cost.flops)
                 with timer.phase(phase_label) as ph:
                     out = runner(jnp.array(u_host))
                     ph.block(out)

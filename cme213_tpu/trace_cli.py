@@ -6,10 +6,13 @@ pass over the structured form — the JSON-lines files ``core/trace.py``
 sinks (``CME213_TRACE_FILE``, one file per rank via ``{rank}``
 templating).  Three commands:
 
-- ``summary``  — per-phase/per-kernel span time, served-rung and demotion
-  counts, checkpoint-commit latency percentiles, fault/retry/rollback
-  tallies, gang verdicts.  ``--require a,b`` fails (exit 1) when a named
-  span never completed — the CI smoke gate.
+- ``summary``  — per-phase/per-kernel span time, compile-vs-run split and
+  retrace counts, roofline attribution, served-rung and demotion counts,
+  checkpoint-commit latency percentiles, fault/retry/rollback tallies,
+  gang verdicts.  ``--require a,b`` fails (exit 1) when a named span
+  never completed — the CI smoke gate.  ``--json`` prints the same
+  aggregates as one JSON document (what CI and the regression gate
+  consume instead of scraping text).
 - ``timeline`` — one chronological line per event with relative
   timestamps and rank labels (span-begin records are folded into their
   span-end line; ``--all`` shows everything).
@@ -18,6 +21,14 @@ templating).  Three commands:
   merged gang view instead — launch, heartbeats, epoch commits, the
   stall/exit verdict, restart, resume — which is how a 2-rank rankkill
   faultcheck run is reconstructed after the fact.
+- ``export``   — convert traces (including ``merge``-style multi-rank
+  sets) to Chrome trace-event JSON loadable in Perfetto or
+  ``chrome://tracing``: rank → pid, span nesting depth → tid, spans as
+  B/E pairs, everything else as instant events.
+- ``regress``  — the bench regression gate (``cme213_tpu.bench.regress``
+  under the trace umbrella): fresh sweep CSVs + ``metrics.json`` vs a
+  banked baseline directory, machine-readable verdict, nonzero exit
+  under ``--strict``.
 
 Any unparseable line is a hard error (exit 2): a trace that cannot be
 trusted end-to-end must fail the smoke gate, not be silently skipped.
@@ -130,6 +141,56 @@ def summarize(events: list[dict], out=None) -> dict:
         w(f"open spans (begun, never ended — killed mid-flight?): "
           f"{', '.join(sorted(b['span'] for b in begun.values()))}\n")
 
+    # compile vs run split per (op, shape class) + the retrace detector
+    # (ROADMAP item 5's measurement half): spans named <op>.compile /
+    # <op>.run carrying a shape_class tag
+    split = defaultdict(lambda: {"compiles": 0, "compile_ms": 0.0,
+                                 "runs": 0, "run_ms": 0.0})
+    for e in events:
+        if e["event"] != "span-end" or "shape_class" not in e:
+            continue
+        nm, ms = e.get("span", ""), e.get("ms")
+        if not isinstance(ms, (int, float)):
+            continue
+        if nm.endswith(".compile"):
+            d = split[(nm[:-len(".compile")], e["shape_class"])]
+            d["compiles"] += 1
+            d["compile_ms"] += ms
+        elif nm.endswith(".run"):
+            d = split[(nm[:-len(".run")], e["shape_class"])]
+            d["runs"] += 1
+            d["run_ms"] += ms
+    retraces = Counter((e.get("op"), e.get("shape_class")) for e in events
+                       if e["event"] == "compile-retrace")
+    if split:
+        w("compile vs run (ms):\n")
+        w(f"  {'op [shape class]':<38} {'compiles':>8} {'ms':>9} "
+          f"{'runs':>5} {'ms':>9}\n")
+        for (op, sc), d in sorted(split.items()):
+            w(f"  {f'{op} [{sc}]':<38} {d['compiles']:>8} "
+              f"{d['compile_ms']:>9.2f} {d['runs']:>5} {d['run_ms']:>9.2f}\n")
+    if retraces:
+        w(f"compile retraces: {sum(retraces.values())} ("
+          + ", ".join(f"{op} [{sc}] x{n}"
+                      for (op, sc), n in sorted(retraces.items())) + ")\n")
+
+    # roofline attribution: span-ends that declared their cost model
+    # (sp.roofline(...)) carry achieved_gbs / pct_peak / bound
+    att = defaultdict(list)
+    for e in events:
+        if e["event"] == "span-end" and "achieved_gbs" in e:
+            att[(e.get("span", "?"), str(e.get("kernel", "-")))].append(e)
+    if att:
+        w("roofline attribution:\n")
+        for (nm, kernel), recs in sorted(att.items()):
+            best = max(recs, key=lambda r: r.get("achieved_gbs") or 0)
+            line = (f"  {nm} [{kernel}]: best "
+                    f"{best.get('achieved_gbs')} GB/s")
+            if best.get("pct_peak") is not None:
+                line += (f" ({best['pct_peak']}% of peak, "
+                         f"{best.get('bound')}-bound)")
+            w(line + f" x{len(recs)}\n")
+
     served = Counter((e["op"], e["rung"]) for e in events
                      if e["event"] == "served")
     demoted_serves = sum(1 for e in events
@@ -231,11 +292,29 @@ def summarize(events: list[dict], out=None) -> dict:
         w("faults injected: "
           + ", ".join(f"{k} x{n}" for k, n in sorted(faults.items())) + "\n")
 
+    # all keys are strings so the dict doubles as the --json document
     return {"events": len(events), "ranks": ranks, "spans": dict(by_span),
-            "served": dict(served), "rung_failed": dict(rung_failed),
+            "served": {f"{op}.{rung}": n for (op, rung), n in served.items()},
+            "rung_failed": {f"{op}.{rung}": n
+                            for (op, rung), n in rung_failed.items()},
+            "compile_run": {f"{op} [{sc}]": d
+                            for (op, sc), d in split.items()},
+            "retraces": {f"{op} [{sc}]": n
+                         for (op, sc), n in retraces.items()},
+            "attribution": {
+                f"{nm} [{kernel}]": {
+                    "count": len(recs),
+                    "best_gbs": max(r.get("achieved_gbs") or 0
+                                    for r in recs),
+                    "pct_peak": max((r.get("pct_peak") or 0 for r in recs),
+                                    default=0) or None,
+                    "bound": recs[-1].get("bound"),
+                } for (nm, kernel), recs in att.items()},
             "commits": len(commits), "commit_ms": commit_stats,
             "resumes": len(loads), "verdicts": len(verdicts),
-            "restarts": len(restarts), "invalid": dict(invalid),
+            "restarts": len(restarts),
+            "invalid": {f"{ev}:{field}": n
+                        for (ev, field), n in invalid.items()},
             "conformance": {f"{op}.{rung}": {"ok": ok, "count": n}
                             for (op, rung, ok), n in conf.items()},
             "admission": {"rejected": len(rejected), "shrunk": len(shrunk)},
@@ -289,6 +368,96 @@ def render_timeline(events: list[dict], out=None,
                   f"{e['event']:<22} {_detail(e)}\n")
 
 
+# ------------------------------------------------------------------ export
+
+def _chrome_pid(rec: dict) -> int:
+    """rank → Chrome pid: rank r → r+1, non-rank (launcher/main) → 0."""
+    r = rec.get("rank")
+    return r + 1 if isinstance(r, int) else 0
+
+
+def to_chrome_trace(events: list[dict]) -> dict:
+    """Convert trace records to the Chrome trace-event format (Perfetto /
+    ``chrome://tracing``).
+
+    Mapping: rank → pid (with ``process_name`` metadata naming each),
+    span nesting depth → tid (a span's depth comes from its parent
+    chain, so causal trees render as stacked tracks), span begin/end
+    pairs → ``B``/``E`` duration events, a ``span-end`` whose begin is
+    missing (ring-buffer truncation) → a self-contained ``X`` complete
+    event reconstructed from its ``ms``, and every non-span record → an
+    instant (``i``) event.  Open spans (begun, never ended — a killed
+    rank) are dropped so begin/end pairing stays valid for the viewer.
+    Timestamps are microseconds relative to the first record.
+    """
+    ts = [e["t"] for e in events if isinstance(e.get("t"), (int, float))]
+    t0 = min(ts) if ts else 0.0
+
+    def us(t) -> float:
+        return round((t - t0) * 1e6, 3)
+
+    begins = {e.get("id"): e for e in events if e["event"] == "span-begin"}
+    ends = {e.get("id"): e for e in events if e["event"] == "span-end"}
+
+    depth_memo: dict = {}
+
+    def depth(sid) -> int:
+        d, chain = 0, sid
+        seen = set()
+        while chain is not None and chain not in seen:
+            if chain in depth_memo:
+                d += depth_memo[chain]
+                break
+            seen.add(chain)
+            rec = begins.get(chain) or ends.get(chain)
+            parent = rec.get("parent") if rec else None
+            if parent is None:
+                break
+            d += 1
+            chain = parent
+        depth_memo[sid] = d
+        return d
+
+    out, pids = [], {}
+    for e in events:
+        pid = _chrome_pid(e)
+        if pid not in pids:
+            pids[pid] = ("main" if pid == 0
+                         else f"rank {e.get('rank')}")
+        args = {k: v for k, v in e.items()
+                if k not in _BASE_FIELDS and k not in ("span", "id",
+                                                       "parent")}
+        t = e.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        if e["event"] == "span-begin":
+            if e.get("id") not in ends:
+                continue  # open span: dropped to keep pairing valid
+            out.append({"name": e.get("span", "?"), "cat": "span",
+                        "ph": "B", "ts": us(t), "pid": pid,
+                        "tid": depth(e.get("id")), "args": args})
+        elif e["event"] == "span-end":
+            sid = e.get("id")
+            ms = e.get("ms") if isinstance(e.get("ms"), (int, float)) else 0.0
+            if sid in begins:
+                out.append({"name": e.get("span", "?"), "cat": "span",
+                            "ph": "E", "ts": us(t), "pid": pid,
+                            "tid": depth(sid), "args": args})
+            else:  # begin lost (ring buffer): reconstruct from ms
+                out.append({"name": e.get("span", "?"), "cat": "span",
+                            "ph": "X", "ts": us(t - ms / 1e3),
+                            "dur": round(ms * 1e3, 3), "pid": pid,
+                            "tid": depth(sid), "args": args})
+        else:
+            out.append({"name": e["event"], "cat": "event", "ph": "i",
+                        "s": "p", "ts": us(t), "pid": pid, "tid": 0,
+                        "args": args})
+    out.sort(key=lambda ev: ev["ts"])
+    meta = [{"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": label}} for pid, label in sorted(pids.items())]
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
 # -------------------------------------------------------------------- main
 
 def main(argv: list[str] | None = None) -> int:
@@ -305,6 +474,10 @@ def main(argv: list[str] | None = None) -> int:
                             "name — e.g. conformance-failed — must occur "
                             "at least once); exit 1 otherwise — the CI "
                             "gate")
+    p_sum.add_argument("--json", action="store_true",
+                       help="print the aggregates as one JSON document "
+                            "instead of the text report (what CI and the "
+                            "regression gate consume)")
 
     p_tl = sub.add_parser("timeline", help="chronological event listing")
     p_tl.add_argument("files", nargs="+")
@@ -319,6 +492,26 @@ def main(argv: list[str] | None = None) -> int:
     p_mg.add_argument("--out", default=None,
                       help="write merged JSON lines here (default stdout)")
 
+    p_ex = sub.add_parser("export", help="Chrome trace-event JSON "
+                                         "(Perfetto / chrome://tracing)")
+    p_ex.add_argument("files", nargs="+")
+    p_ex.add_argument("--out", default=None,
+                      help="write the Chrome trace here (default stdout)")
+
+    p_rg = sub.add_parser("regress", help="bench regression gate "
+                                          "(cme213_tpu.bench.regress)")
+    p_rg.add_argument("args", nargs=argparse.REMAINDER,
+                      help="arguments forwarded to bench.regress")
+
+    # intercepted before argparse: REMAINDER won't swallow leading flags
+    # (``trace regress --fresh ...``), and regress owns its own CLI
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "regress":
+        from .bench.regress import main as regress_main
+
+        return regress_main(list(argv[1:]))
+
     args = ap.parse_args(argv)
     try:
         events = load_events(args.files)
@@ -326,8 +519,22 @@ def main(argv: list[str] | None = None) -> int:
         print(f"trace: {e}", file=sys.stderr)
         return 2
 
+    if args.cmd == "export":
+        doc = to_chrome_trace(events)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(doc, f, default=str)
+        else:
+            json.dump(doc, sys.stdout, default=str)
+            sys.stdout.write("\n")
+        return 0
     if args.cmd == "summary":
-        agg = summarize(events)
+        import io
+
+        text = io.StringIO() if args.json else None
+        agg = summarize(events, out=text)
+        if args.json:
+            print(json.dumps(agg, indent=2, default=str))
         required = [s.strip() for s in args.require.split(",") if s.strip()]
         missing = [s for s in required
                    if s not in agg["spans"] and not agg["counts"].get(s)]

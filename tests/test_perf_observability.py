@@ -1,0 +1,518 @@
+"""Performance observability (ISSUE 6): roofline attribution, the
+compile/run split + retrace detector, Chrome-trace export, and the bench
+regression gate.
+
+The load-bearing pins: cost-model bytes against hand-computed values
+(the f32 ``gbs`` columns must not move when sweeps route through the
+models), Chrome export structural validity (valid JSON, begin/end
+pairing, rank→pid) on a synthetic 2-rank merged gang trace, the retrace
+detector firing on a forced recompile of a known shape class, and the
+regression gate's pass/fail verdicts on fixture metric pairs.
+"""
+
+import csv
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from cme213_tpu.core import metrics, roofline, trace
+from cme213_tpu.core.trace import span
+from cme213_tpu import trace_cli
+from cme213_tpu.bench import regress
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    trace.flush_sink()
+    trace.clear_events()
+    yield
+    trace.flush_sink()
+    trace.clear_events()
+
+
+# -------------------------------------------------------------- cost models
+
+def test_heat_cost_hand_computed():
+    # (1 read + 1 write) x 4 B x n^2 per iteration; 38 flops/point at
+    # order 8 (the reference's data.ods accounting)
+    c = roofline.heat_cost(100, order=8, iters=10)
+    assert c.nbytes == 2 * 4 * 100 * 100 * 10
+    from cme213_tpu.ops.stencil import flops_per_point
+
+    assert flops_per_point(8) == 38
+    assert c.flops == 38 * 100 * 100 * 10
+    # dtype-aware by construction: f64 doubles the bytes, not the flops
+    c64 = roofline.heat_cost(100, order=8, iters=10, dtype="f64")
+    assert c64.nbytes == 2 * c.nbytes and c64.flops == c.flops
+    # rectangular grids: ny x nx
+    assert roofline.heat_cost(10, 20, order=2, iters=1).nbytes == 2 * 4 * 200
+
+
+def test_spmv_cost_hand_computed_and_delegation():
+    from cme213_tpu.apps import spmv_scan as sp
+
+    c = roofline.spmv_scan_cost(1000, 7)
+    assert c.nbytes == 1000 * (3 * 4 + 4) * 7 == sp.bytes_moved(1000, 7)
+    assert sp.bytes_moved(1000, 2, elem=8) == 1000 * (3 * 8 + 4) * 2
+    assert c.flops == 2 * 1000 * 7
+
+
+def test_pagerank_cost_hand_computed_and_delegation():
+    from cme213_tpu.apps import pagerank
+
+    g = pagerank.build_graph(256, 4, seed=0)
+    e = g.edges.shape[0]
+    c = roofline.pagerank_cost(g.num_nodes, e, 6)
+    assert c.nbytes == (e * 12 + 256 * 12) * 6 == pagerank.bytes_moved(g, 6)
+
+
+def test_cipher_scan_transpose_costs():
+    assert roofline.cipher_cost(4096).nbytes == 2 * 4096
+    assert roofline.scan_cost(1 << 10).nbytes == 2 * 4 * (1 << 10)
+    assert roofline.transpose_cost(64, 32).nbytes == 2 * 4 * 64 * 32
+    assert roofline.transfer_cost(12345).nbytes == 12345
+    # merge: ceil(log2 n) read+write passes; radix: 4 passes on u32 keys
+    assert roofline.sort_cost(1024, "merge").nbytes == 2 * 4 * 1024 * 10
+    assert roofline.sort_cost(1024, "radix").nbytes == 2 * 4 * 1024 * 4
+
+
+def test_cost_gbs_helper():
+    c = roofline.Cost(nbytes=2_000_000_000, flops=0)
+    assert c.gbs(1000.0) == 2.0  # 2 GB in 1 s
+    assert c.gbs(0.0) == 0.0
+
+
+# ------------------------------------------------------------- device peaks
+
+def test_peak_registry_and_env_override(monkeypatch):
+    assert roofline.BUILTIN_PEAKS["tpu-v5e"].gbs == 819.0
+    assert roofline.peak_for("TPU v5 lite").name == "tpu-v5e"
+    assert roofline.peak_for("TPU v4").name == "tpu-v4"
+    assert roofline.peak_for("mystery-chip") is None
+    monkeypatch.setenv(roofline.DEVICE_PEAKS_ENV,
+                       "mystery-chip:123:456, cpu:50:500, bad-entry")
+    assert roofline.peak_for("mystery-chip").gbs == 123.0
+    assert roofline.peak_for("cpu").gfs == 500.0  # override wins
+
+
+def test_bench_peak_constant_matches_registry():
+    """bench.py keeps a literal (imports must stay lazy there) pinned to
+    the central registry."""
+    import bench
+
+    assert bench.HBM_PEAK_GBS == roofline.BUILTIN_PEAKS["tpu-v5e"].gbs
+
+
+def test_attribute_pct_peak_and_bound(monkeypatch):
+    monkeypatch.setenv(roofline.DEVICE_PEAKS_ENV, "testdev:100:1000")
+    att = roofline.attribute(10.0, 1.0, device="testdev")
+    assert att["pct_peak"] == 10.0 and att["bound"] == "memory"
+    # high operational intensity flips the verdict
+    att = roofline.attribute(1.0, 900.0, device="testdev")
+    assert att["bound"] == "compute"
+    # unknown device / no signal -> no verdict
+    assert roofline.attribute(10.0, device="nope")["pct_peak"] is None
+    assert roofline.attribute(0.0, device="testdev")["pct_peak"] is None
+
+
+def test_span_roofline_attribution(monkeypatch):
+    monkeypatch.setenv(roofline.DEVICE_PEAKS_ENV, "testdev:100:1000")
+    monkeypatch.setattr(roofline, "_DETECTED", "testdev")
+    with span("op.run", kernel="k", shape_class="s") as sp:
+        sp.roofline(1_000_000, 10_000)
+    end = trace.events("span-end")[-1]
+    assert end["achieved_gbs"] > 0
+    assert end["pct_peak"] > 0 and end["bound"] == "memory"
+
+
+# ------------------------------------------------- compile/run + retraces
+
+def test_compile_run_histograms_and_retrace_detector():
+    metrics.reset()
+    with span("op.compile", shape_class="a"):
+        pass
+    with span("op.run", shape_class="a"):
+        pass
+    assert trace.events("compile-retrace") == []
+    # a different shape class is a fresh compile, not a retrace
+    with span("op.compile", shape_class="b"):
+        pass
+    assert trace.events("compile-retrace") == []
+    # the known class compiling again IS one
+    with span("op.compile", shape_class="a"):
+        pass
+    ev = trace.events("compile-retrace")
+    assert len(ev) == 1
+    assert ev[0]["op"] == "op" and ev[0]["shape_class"] == "a"
+    assert ev[0]["count"] == 2
+    snap = metrics.snapshot()
+    assert snap["counters"]["compile.retraces"] == 1
+    assert snap["histograms"]["compile.op.a.ms"]["count"] == 2
+    assert snap["histograms"]["compile.op.b.ms"]["count"] == 1
+    assert snap["histograms"]["run.op.a.ms"]["count"] == 1
+    assert trace.compile_counts()[("op", "a")] == 2
+
+
+def test_errored_compile_span_is_not_a_retrace():
+    for _ in range(2):
+        with pytest.raises(ValueError):
+            with span("op.compile", shape_class="x"):
+                raise ValueError("no lowering")
+    assert trace.events("compile-retrace") == []
+    assert ("op", "x") not in trace.compile_counts()
+
+
+def test_forced_recompile_fires_through_real_dispatch(tmp_path, monkeypatch,
+                                                      capsys):
+    """Acceptance: a forced recompile of a known shape class produces a
+    compile-retrace event visible in trace summary."""
+    from cme213_tpu.apps import spmv_scan as sp
+
+    path = tmp_path / "t.jsonl"
+    monkeypatch.setenv(trace.TRACE_FILE_ENV, str(path))
+    prob = sp.generate_problem(256, 5, 4, iters=2, seed=1)
+    sp.run_spmv_scan(prob, kernel="flat")
+    # dispatch builds a fresh jit closure per call: same shape class,
+    # second warmup -> the retrace the compile-cache item must kill
+    sp.run_spmv_scan(prob, kernel="flat")
+    trace.flush_sink()
+    monkeypatch.delenv(trace.TRACE_FILE_ENV)
+    assert trace.events("compile-retrace")
+    capsys.readouterr()
+    assert trace_cli.main(["summary", str(path),
+                           "--require", "compile-retrace"]) == 0
+    out = capsys.readouterr().out
+    assert "compile retraces: 1" in out
+    assert "compile vs run (ms):" in out
+    assert "roofline attribution:" in out
+
+
+# --------------------------------------------------------------- summary
+
+def _write_trace(path, recs):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def _gang_fixture(tmp_path):
+    """Synthetic 2-rank gang trace with nested spans (the export pins)."""
+    base = {"pid": 11, "incarnation": 0}
+    r0 = [
+        {"event": "span-begin", "t": 1.0, "rank": 0, "span": "solve",
+         "id": "a.1", "parent": None, **base},
+        {"event": "span-begin", "t": 1.1, "rank": 0, "span": "solve.compile",
+         "id": "a.2", "parent": "a.1", "shape_class": "n64", **base},
+        {"event": "span-end", "t": 1.4, "rank": 0, "span": "solve.compile",
+         "id": "a.2", "parent": "a.1", "ms": 300.0, "shape_class": "n64",
+         **base},
+        {"event": "heartbeat", "t": 1.5, "rank": 0, "step": 1, **base},
+        {"event": "span-end", "t": 2.0, "rank": 0, "span": "solve",
+         "id": "a.1", "parent": None, "ms": 1000.0, **base},
+    ]
+    r1 = [
+        {"event": "span-begin", "t": 1.2, "rank": 1, "span": "solve",
+         "id": "b.1", "parent": None, "pid": 12, "incarnation": 0},
+        {"event": "span-end", "t": 1.9, "rank": 1, "span": "solve",
+         "id": "b.1", "parent": None, "ms": 700.0, "pid": 12,
+         "incarnation": 0},
+        # an end whose begin was lost to the ring buffer -> X event
+        {"event": "span-end", "t": 2.1, "rank": 1, "span": "orphan",
+         "id": "b.9", "parent": None, "ms": 50.0, "pid": 12,
+         "incarnation": 0},
+        # an open span (killed rank) must be dropped, not left unpaired
+        {"event": "span-begin", "t": 2.2, "rank": 1, "span": "open",
+         "id": "b.5", "parent": None, "pid": 12, "incarnation": 0},
+    ]
+    launcher = [
+        {"event": "gang-launch", "t": 0.5, "rank": None, "incarnation": 0,
+         "world": 2, "coordinator": "127.0.0.1:1", "pid": 9},
+    ]
+    paths = []
+    for name, recs in (("trace-main.jsonl", launcher),
+                       ("trace-0.jsonl", r0), ("trace-1.jsonl", r1)):
+        p = tmp_path / name
+        _write_trace(p, recs)
+        paths.append(str(p))
+    return paths
+
+
+def test_summary_json_machine_readable(tmp_path, capsys):
+    paths = _gang_fixture(tmp_path)
+    assert trace_cli.main(["summary", *paths, "--json"]) == 0
+    out = capsys.readouterr().out
+    agg = json.loads(out)  # the whole stdout is one JSON document
+    assert agg["events"] == 10
+    assert agg["ranks"] == ["main", "r0", "r1"]
+    assert agg["spans"]["solve"] == [700.0, 1000.0]
+    assert agg["compile_run"]["solve [n64]"]["compiles"] == 1
+    assert agg["counts"]["heartbeat"] == 1
+
+
+def test_summary_json_respects_require(tmp_path, capsys):
+    paths = _gang_fixture(tmp_path)
+    assert trace_cli.main(["summary", *paths, "--json",
+                           "--require", "absent"]) == 1
+
+
+# ---------------------------------------------------------------- export
+
+def test_chrome_export_round_trip(tmp_path, capsys):
+    paths = _gang_fixture(tmp_path)
+    out_path = tmp_path / "chrome.json"
+    assert trace_cli.main(["export", *paths, "--out", str(out_path)]) == 0
+    doc = json.loads(out_path.read_text())  # valid JSON
+    evs = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+
+    # rank -> pid mapping: main=0, rank0=1, rank1=2, named via metadata
+    names = {e["pid"]: e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert names == {0: "main", 1: "rank 0", 2: "rank 1"}
+
+    # begin/end pairing: every B has a matching E on the same (pid, tid),
+    # properly nested in time (a stack machine never underflows)
+    stacks = {}
+    for e in sorted((e for e in evs if e["ph"] in "BE"),
+                    key=lambda e: e["ts"]):
+        key = (e["pid"], e["tid"])
+        if e["ph"] == "B":
+            stacks.setdefault(key, []).append(e["name"])
+        else:
+            assert stacks.get(key), f"E without B on {key}"
+            assert stacks[key].pop() == e["name"]
+    assert all(not s for s in stacks.values())
+    n_b = sum(1 for e in evs if e["ph"] == "B")
+    assert n_b == sum(1 for e in evs if e["ph"] == "E") == 3
+
+    # nesting depth -> tid: the compile child sits on tid 1 under its
+    # parent's tid 0
+    compile_b = next(e for e in evs if e["ph"] == "B"
+                     and e["name"] == "solve.compile")
+    assert compile_b["tid"] == 1 and compile_b["pid"] == 1
+
+    # orphaned end reconstructed as a complete (X) event; open span dropped
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["orphan"]
+    assert xs[0]["dur"] == 50.0 * 1e3
+    assert not any(e.get("name") == "open" for e in evs)
+
+    # non-span records become instant events
+    assert {e["name"] for e in evs if e["ph"] == "i"} >= {"heartbeat",
+                                                          "gang-launch"}
+    # chronological for the viewer
+    ts = [e["ts"] for e in evs if e["ph"] != "M"]
+    assert ts == sorted(ts)
+
+
+def test_chrome_export_stdout_and_parse_error(tmp_path, capsys):
+    paths = _gang_fixture(tmp_path)
+    assert trace_cli.main(["export", *paths]) == 0
+    assert json.loads(capsys.readouterr().out)["traceEvents"]
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("not json\n")
+    assert trace_cli.main(["export", str(bad)]) == 2
+
+
+# ------------------------------------------------------------ regression
+
+def _write_csv(path, rows):
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        w.writeheader()
+        w.writerows(rows)
+
+
+def _fixture_dirs(tmp_path, fresh_gbs):
+    base_d, fresh_d = tmp_path / "base", tmp_path / "fresh"
+    base_d.mkdir()
+    fresh_d.mkdir()
+    rows = [{"size": 100, "kernel": "xla", "ms": 10.0, "gbs": 50.0,
+             "error": ""}]
+    _write_csv(base_d / "heat.csv", rows)
+    _write_csv(fresh_d / "heat.csv",
+               [{**rows[0], "gbs": fresh_gbs}])
+    return str(fresh_d), str(base_d)
+
+
+def test_regress_strict_fails_on_20pct_gbs_drop(tmp_path, capsys):
+    """Acceptance: --strict exits nonzero on a synthetic 20% regression."""
+    fresh, base = _fixture_dirs(tmp_path, fresh_gbs=40.0)  # 50 -> 40
+    assert regress.main(["--fresh", fresh, "--baseline", base,
+                         "--strict"]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    # report-only mode flags it but exits 0 (the advisory CI step)
+    assert regress.main(["--fresh", fresh, "--baseline", base]) == 0
+
+
+def test_regress_passes_within_noise(tmp_path, capsys):
+    fresh, base = _fixture_dirs(tmp_path, fresh_gbs=47.5)  # -5% < threshold
+    assert regress.main(["--fresh", fresh, "--baseline", base,
+                         "--strict"]) == 0
+
+
+def test_regress_lower_better_and_lost_signal(tmp_path):
+    base_d, fresh_d = tmp_path / "b", tmp_path / "f"
+    base_d.mkdir()
+    fresh_d.mkdir()
+    _write_csv(base_d / "s.csv", [
+        {"op": "a", "ms": 10.0}, {"op": "b", "ms": 10.0}])
+    _write_csv(fresh_d / "s.csv", [
+        {"op": "a", "ms": 15.0},           # 1.5x slower
+        {"op": "b", "ms": -1.0}])          # error row: lost signal
+    out = tmp_path / "v.json"
+    assert regress.main(["--fresh", str(fresh_d), "--baseline", str(base_d),
+                         "--strict", "--json", str(out)]) == 1
+    verdict = json.loads(out.read_text())
+    assert verdict["verdict"] == "fail"
+    assert {(r["row"], r["metric"]) for r in verdict["regressions"]} == {
+        ("op=a", "ms"), ("op=b", "ms")}
+
+
+def test_regress_metrics_json_row_counts(tmp_path):
+    base_d, fresh_d = tmp_path / "b", tmp_path / "f"
+    base_d.mkdir()
+    fresh_d.mkdir()
+    (base_d / "metrics.json").write_text(json.dumps(
+        {"heat_bandwidth": {"rows": 12}, "scan_bandwidth": {"rows": 4}}))
+    (fresh_d / "metrics.json").write_text(json.dumps(
+        {"heat_bandwidth": {"rows": 9}, "scan_bandwidth": {"rows": 4}}))
+    out = tmp_path / "v.json"
+    assert regress.main(["--fresh", str(fresh_d), "--baseline", str(base_d),
+                         "--strict", "--json", str(out)]) == 1
+    verdict = json.loads(out.read_text())
+    assert verdict["regressions"][0]["row"] == "heat_bandwidth"
+    assert verdict["regressions"][0]["metric"] == "rows"
+
+
+def test_regress_no_overlap_is_advisory_pass(tmp_path, capsys):
+    base_d, fresh_d = tmp_path / "b", tmp_path / "f"
+    base_d.mkdir()
+    fresh_d.mkdir()
+    _write_csv(base_d / "x.csv", [{"k": 1, "gbs": 5.0}])
+    _write_csv(fresh_d / "y.csv", [{"k": 1, "gbs": 5.0}])
+    assert regress.main(["--fresh", str(fresh_d), "--baseline", str(base_d),
+                         "--strict"]) == 0
+    assert "nothing to compare" in capsys.readouterr().out
+
+
+def test_regress_banked_cpu_baselines_self_compare():
+    """Acceptance: zero exit on the banked CPU baselines."""
+    banked = str(Path(__file__).resolve().parent.parent
+                 / "bench_results" / "cpu")
+    assert regress.main(["--fresh", banked, "--baseline", banked,
+                         "--strict"]) == 0
+
+
+def test_regress_trajectory_from_bench_captures(tmp_path):
+    hist = tmp_path / "hist"
+    hist.mkdir()
+    (hist / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "rc": 0, "tail": 'noise\n{"metric": "heat", '
+                                  '"value": 100.0, "unit": "GB/s"}'}))
+    (hist / "BENCH_r02.json").write_text(json.dumps(
+        {"metric": "heat", "value": 50.0, "unit": "GB/s"}))
+    fresh_bench = tmp_path / "bench.json"
+    fresh_bench.write_text(json.dumps({"metric": "heat", "value": 61.0}))
+    t = regress.trajectory_check(str(fresh_bench), str(hist), 0.1)
+    assert t["best_prior"]["value"] == 100.0
+    assert t["regression"] is True  # 0.61x: the BENCH_r02 class
+    ok = regress.trajectory_check(str(fresh_bench), str(hist), 0.5)
+    assert ok["regression"] is False
+
+
+def test_regress_via_trace_cli(tmp_path):
+    fresh, base = _fixture_dirs(tmp_path, fresh_gbs=40.0)
+    assert trace_cli.main(["regress", "--fresh", fresh, "--baseline", base,
+                           "--strict"]) == 1
+
+
+# -------------------------------------------------- sweep columns + bench
+
+def test_sweep_rows_carry_pct_peak_and_bound(monkeypatch):
+    """Every sweep CSV row carries pct_peak/bound from the one cost-model
+    source of truth, and the f32 gbs math is unchanged."""
+    monkeypatch.setenv(roofline.DEVICE_PEAKS_ENV, "cpu:40:400")
+    from cme213_tpu.bench.sweeps import heat_sweep, scan_sweep
+
+    rows = heat_sweep(sizes=(32,), orders=(2,), iters=2, ks=(1,))
+    for r in rows:
+        assert "pct_peak" in r and "bound" in r
+        assert r["bound"] == "memory"
+        c = roofline.heat_cost(r["size"], order=r["order"],
+                               iters=r["iters"], dtype=r["dtype"])
+        # unchanged f32 math (rel tolerance: ms is rounded in the row)
+        assert r["gbs"] == pytest.approx(c.gbs(r["ms"]), rel=0.1)
+        # pct_peak derives from the unrounded gbs; the CSV gbs is rounded
+        # to 2 decimals, so compare loosely at these tiny CI sizes
+        assert r["pct_peak"] == pytest.approx(
+            100 * r["gbs"] / 40.0, rel=0.05, abs=0.05)
+    rows = scan_sweep(n=1 << 10, num_segments=4)
+    assert all("pct_peak" in r and "bound" in r for r in rows)
+
+
+def test_bench_kernel_failure_events_and_attribution(monkeypatch, capsys):
+    """bench.py parent records per-rung failures as structured
+    kernel-failure events and fills attribution on measured rows."""
+    import subprocess
+
+    import bench
+
+    def fake_run(cmd, **kwargs):
+        name = next(a.split("=", 1)[1] for a in cmd
+                    if a.startswith("--kernel="))
+        if name == "xla":
+            return type("P", (), {
+                "returncode": 0, "stderr": "",
+                "stdout": json.dumps({
+                    "kernel": name, "ok": True, "iters": 100,
+                    "platform": "tpu", "ms_per_iter": 1.0,
+                    "gbs": 200.0, "gflops": 9.5}) + "\n"})()
+        return type("P", (), {
+            "returncode": 0, "stderr": "",
+            "stdout": json.dumps({
+                "kernel": name, "ok": False, "platform": "tpu",
+                "error": "UNAVAILABLE: pallas lowering"}) + "\n"})()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    failures = trace.events("kernel-failure")
+    assert len(failures) == len(bench.KERNELS) - 1
+    assert all(trace.validate_record(r) == [] for r in failures)
+    assert failures[0]["op"] == "heat2d"
+    assert "UNAVAILABLE" in failures[0]["error"]
+    # parent-side attribution vs the v5e registry entry (819 GB/s)
+    assert out["pct_peak"] == pytest.approx(100 * 200.0 / 819.0, rel=1e-3)
+    assert out["bound"] == "memory"
+    row = next(r for r in out["kernels"] if r["kernel"] == "xla")
+    assert row["pct_peak"] == out["pct_peak"]
+
+
+def test_run_all_profile_dir_hook(tmp_path, monkeypatch):
+    """CME213_PROFILE_DIR wraps the run in jax.profiler.trace and records
+    device-memory snapshots as structured events."""
+    from cme213_tpu.bench import run_all
+
+    prof = tmp_path / "prof"
+    monkeypatch.setenv("CME213_PROFILE_DIR", str(prof))
+    rc = run_all.main(["--out", str(tmp_path / "out"), "--quick",
+                       "--only", "scan_bandwidth"])
+    assert rc == 0
+    ev = trace.events("device-memory")
+    assert ev and Path(ev[0]["path"]).exists()
+    assert ev[0]["bytes"] > 0
+    assert all(trace.validate_record(r) == [] for r in ev)
+    assert any(prof.rglob("*"))  # the XPlane profile landed
+
+
+def test_event_schema_covers_new_events():
+    for name, fields in (("kernel-failure", ("op", "kernel", "error")),
+                         ("device-memory", ("path", "bytes")),
+                         ("compile-retrace", ("op", "shape_class",
+                                              "count"))):
+        assert trace.EVENT_SCHEMA[name] == fields
