@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import sys
 from dataclasses import dataclass, field
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -24,7 +25,7 @@ from ..core import PhaseTimer, bandwidth_gbs, gflops
 from ..dist import mesh_for_method, run_distributed_heat
 from ..grid import make_initial_grid, save_grid_to_file
 from ..ops import run_heat
-from ..ops.stencil import flops_per_point
+from ..ops.stencil import BORDER_FOR_ORDER, flops_per_point, stencil_interior
 from ..ops.stencil_pipeline import pick_pipeline_tile, run_heat_resilient
 from ..verify import check_ulp, golden
 
@@ -107,6 +108,46 @@ def run_single(params: SimParams, check_cpu: bool = True,
     for r in result.reports:
         print(r)
     return result
+
+
+@partial(jax.jit, static_argnames=("iters", "order"), donate_argnums=(0,))
+def _heat_batched(u, iters: int, order: int, xcfl, ycfl):
+    """B same-shape heat solves as one device program: ``u`` is a
+    (B, gy, gx) stack, ``xcfl``/``ycfl`` are per-lane (B,) scalars, and
+    each lane runs the exact ``run_heat`` loop body under ``jax.vmap`` —
+    so per-lane results are bitwise-equal to the serial solve (pinned by
+    tests/test_serve.py)."""
+    b = BORDER_FOR_ORDER[order]
+
+    def one(g0, xc, yc):
+        def body(_, g):
+            return g.at[b:-b, b:-b].set(stencil_interior(g, order, xc, yc))
+
+        return jax.lax.fori_loop(0, iters, body, g0)
+
+    return jax.vmap(one)(u, xcfl, ycfl)
+
+
+def run_heat_batched(grids: list[np.ndarray], iters: int, order: int,
+                     xcfls: list[float],
+                     ycfls: list[float]) -> list[np.ndarray]:
+    """Serve B same-class heat requests (equal grid shape, ``order``,
+    ``iters``) from one jitted program — the vmap/stacking path the
+    serving layer batches same-shape-class grids through.  CFL factors
+    ride as vmapped per-lane scalars, so requests need not share them to
+    share a bucket."""
+    if not grids:
+        return []
+    shape = np.asarray(grids[0]).shape
+    for g in grids:
+        if np.asarray(g).shape != shape:
+            raise ValueError(
+                f"batch mixes grid shapes: {np.asarray(g).shape} vs {shape}")
+    u = jnp.asarray(np.stack([np.asarray(g) for g in grids]), jnp.float32)
+    out = np.asarray(_heat_batched(
+        u, iters, order, jnp.asarray(xcfls, jnp.float32),
+        jnp.asarray(ycfls, jnp.float32)))
+    return [out[i] for i in range(len(grids))]
 
 
 def run_heat_checkpointed(params: SimParams, path: str, every: int = 0,

@@ -191,6 +191,80 @@ def _iterate(a, xx, flags, iters: int, scan: str = "auto"):
     return jax.lax.fori_loop(0, iters, body, a)
 
 
+@partial(jax.jit, static_argnames=("iters", "scan"), donate_argnums=(0,))
+def _iterate_batched(a, xx, flags, iters: int, scan: str = "flat"):
+    """B same-shape solves as ONE device program: ``a``/``xx``/``flags``
+    are (B, n) stacks and the whole batch runs under ``jax.vmap`` of the
+    single-solve loop — per-lane arithmetic is the exact expression
+    ``_iterate`` runs, so each lane's result is bitwise-equal to its
+    serial solve (pinned by tests/test_serve.py).  Segment structure may
+    differ freely across lanes (flags are per-lane vectors); only
+    ``(n, iters, dtype)`` must match, which is what the serving layer's
+    shape-class buckets guarantee."""
+    scan_fn = _SCAN_KERNELS[scan]
+
+    def one(v0, xxi, fi):
+        def body(_, v):
+            return scan_fn(v * xxi, fi)
+
+        return jax.lax.fori_loop(0, iters, body, v0)
+
+    return jax.vmap(one)(a, xx, flags)
+
+
+def pad_problem(prob: Problem, n_to: int) -> Problem:
+    """Zero-pad a problem to ``n_to`` values with the tail quarantined in
+    its own segment (the ``_shard_problem`` convention): padded values are
+    0·x[0] and never combine into a real segment, so the first ``n``
+    outputs are bitwise-equal to the unpadded solve.  This is what lets
+    degraded-mode serving merge near-sized requests into coarser
+    power-of-two buckets."""
+    n = prob.n
+    if n_to < n:
+        raise ValueError(f"cannot pad n={n} down to {n_to}")
+    if n_to == n:
+        return prob
+    a = np.zeros(n_to, dtype=prob.a.dtype)
+    a[:n] = prob.a
+    k = np.zeros(n_to, dtype=prob.k.dtype)
+    k[:n] = prob.k
+    s = np.concatenate([prob.s[:-1], [n, n_to]]).astype(prob.s.dtype)
+    return Problem(a, s, k, prob.x, prob.iters)
+
+
+def run_spmv_scan_batched(probs: list[Problem], kernel: str = "flat",
+                          dtype=jnp.float32) -> list[np.ndarray]:
+    """Serve B same-class problems (equal ``n`` and ``iters``) from one
+    jitted program — the vmap/stacking path the serving layer
+    (``cme213_tpu/serve``) batches same-shape-class requests through.
+    Only the XLA scans batch (``flat``/``blocked``/``auto``); per-request
+    results come back unstacked, each bitwise-equal to its serial
+    ``_iterate`` solve."""
+    if kernel not in _SCAN_KERNELS:
+        raise ValueError(f"batched serving uses the XLA kernels "
+                         f"{tuple(_SCAN_KERNELS)}, not {kernel!r}")
+    if not probs:
+        return []
+    n, iters = probs[0].n, probs[0].iters
+    for p in probs:
+        p.validate()
+        if (p.n, p.iters) != (n, iters):
+            raise ValueError(
+                f"batch mixes shape classes: n{p.n}/i{p.iters} vs "
+                f"n{n}/i{iters}")
+    a = jnp.asarray(np.stack([p.a for p in probs]), dtype)
+    xx = jnp.asarray(np.stack([p.xx for p in probs]), dtype)
+    # head flags built host-side in one pass: B device dispatches of
+    # head_flags_from_starts would dominate the batching win for small
+    # problems (each segment start is one scatter index here)
+    fl = np.zeros((len(probs), n), np.int32)
+    for i, p in enumerate(probs):
+        fl[i, p.s[:-1]] = 1
+    flags = jnp.asarray(fl)
+    out = np.asarray(_iterate_batched(a, xx, flags, iters, scan=kernel))
+    return [out[i] for i in range(len(probs))]
+
+
 @partial(jax.jit, static_argnames=("iters", "interpret"), donate_argnums=(0,))
 def _iterate_pallas_unfused(a, xx, flags, iters: int, interpret: bool):
     """Per-iteration Pallas scan with the multiply left to XLA — one extra

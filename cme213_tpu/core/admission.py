@@ -174,3 +174,21 @@ def admit_chunk(op: str, initial: int, preflight_at, floor: int = 1,
         record_event("chunk-shrunk", op=op, from_size=size, to_size=smaller,
                      reason="admission-preflight")
         size = smaller
+
+
+def admit_batch(op: str, requested: int, preflight_at,
+                floor: int = 1) -> int:
+    """Batch-size admission for the serving layer: the largest batch size
+    (≤ ``requested``) whose stacked/vmapped program preflights within the
+    budget — the :func:`admit_chunk` halving loop with the size knob
+    meaning "requests per device program".  Requests beyond the admitted
+    size stay queued for the next batch rather than being refused: unlike
+    a solve chunk, a batch can always shrink to 1 without changing any
+    request's result (each lane is an independent solve), so only a
+    single-request program over budget raises :class:`AdmissionError`.
+
+    Serving preflights are cached by the caller per (op, shape-class,
+    size) — the jit cache already makes repeat lowers cheap, but the
+    scheduler shouldn't even reach Python dispatch per batch.
+    """
+    return admit_chunk(op, requested, preflight_at, floor=floor)

@@ -53,6 +53,15 @@ Spec grammar (comma-separated clauses)::
                                   out-of-memory the admission layer
                                   (``core/admission.py``) degrades under;
                                   first incarnation only
+    slow:<op>[:<ms>[:<nth>]]      the <nth> call (1-based, default 1) of
+                                  ``maybe_slow(op)`` injects <ms>
+                                  milliseconds of latency (default 100) —
+                                  the deterministic straggler the serving
+                                  layer's deadline/degradation paths are
+                                  tested against on CPU; the sleep hook is
+                                  injectable so tests advance a virtual
+                                  clock instead of waiting wall-time;
+                                  first incarnation only
 
 Op names are dotted paths (``spmv_scan.pallas-fused``, ``heat.pipeline``,
 ``sweep.heat_bandwidth``); colons are reserved for the grammar.
@@ -90,10 +99,11 @@ class FaultSpecError(ValueError):
 
 @dataclass
 class _Clause:
-    kind: str           # fail | nan | ckpt | rankkill | wrong | oom
+    kind: str           # fail | nan | ckpt | rankkill | wrong | oom | slow
     op: str             # op name ("truncate" for ckpt; rank id for rankkill)
     nth: int = 1        # 1-based trigger call (rankkill: 0-based step)
     count: int = 1      # consecutive triggered calls (fail only)
+    ms: float = 0.0     # injected latency (slow only)
     calls: int = 0      # mutable per-clause call counter
 
     def fires(self) -> bool:
@@ -116,17 +126,26 @@ class FaultPlan:
             parts = raw.split(":")
             kind = parts[0]
             if (kind not in ("fail", "nan", "ckpt", "rankkill", "wrong",
-                             "oom") or len(parts) < 2):
+                             "oom", "slow") or len(parts) < 2):
                 raise FaultSpecError(
                     f"bad fault clause {raw!r} (kinds: fail:<op>[:nth[:count]]"
                     f", nan:<op>[:nth], wrong:<op>[:nth], oom:<op>[:nth], "
-                    f"ckpt:truncate[:nth], rankkill:<rank>[:step])")
+                    f"slow:<op>[:ms[:nth]], ckpt:truncate[:nth], "
+                    f"rankkill:<rank>[:step])")
             try:
                 if kind == "fail":
                     clauses.append(_Clause(
                         kind, parts[1],
                         nth=int(parts[2]) if len(parts) > 2 else 1,
                         count=int(parts[3]) if len(parts) > 3 else 1))
+                elif kind == "slow":
+                    ms = float(parts[2]) if len(parts) > 2 else 100.0
+                    if ms < 0:
+                        raise FaultSpecError(
+                            f"slow clause needs ms >= 0, got {ms}")
+                    clauses.append(_Clause(
+                        kind, parts[1], ms=ms,
+                        nth=int(parts[3]) if len(parts) > 3 else 1))
                 elif kind in ("nan", "wrong", "oom"):
                     clauses.append(_Clause(
                         kind, parts[1],
@@ -282,6 +301,29 @@ def maybe_oom(op: str) -> None:
             raise InjectedResourceExhausted(
                 f"RESOURCE_EXHAUSTED: injected out-of-memory in {op} "
                 f"(call {c.calls})")
+
+
+def maybe_slow(op: str, sleep=None) -> float:
+    """Inject deterministic latency if a ``slow:<op>`` clause fires on
+    this call — the straggler stand-in for a contended device or a slow
+    collective.  Calls ``sleep(seconds)`` (default ``time.sleep``; pass a
+    virtual clock's sleep so tests never wait wall-time) and returns the
+    injected milliseconds (0.0 when nothing fired).  First incarnation
+    only, like ``oom:``/``wrong:``, so a restarted solve runs at speed."""
+    plan = active()
+    if plan is None:
+        return 0.0
+    total = 0.0
+    for c in plan._matching("slow", op):
+        if c.fires() and incarnation() == 0:
+            _record("slow", op, ms=c.ms, call=c.calls)
+            total += c.ms
+    if total:
+        if sleep is None:
+            import time
+            sleep = time.sleep
+        sleep(total / 1e3)
+    return total
 
 
 def maybe_truncate_file(path: str) -> bool:

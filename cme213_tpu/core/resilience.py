@@ -49,6 +49,35 @@ class FailureKind(str, Enum):
     NUMERIC = "numeric"
     RESOURCE = "resource"           # out of device memory: shrink, don't retry
     WRONG_ANSWER = "wrong_answer"   # conformance probe diverged: demote
+    BREAKER_OPEN = "breaker_open"   # circuit open: routed around, not a crash
+
+
+@dataclass
+class Clock:
+    """Injectable time source: ``now()`` (monotonic seconds) + ``sleep``.
+
+    Every wall-time consumer in the serving/retry path takes one of these
+    so tests substitute :class:`VirtualClock` and never sleep for real.
+    """
+
+    now: object = field(default=time.monotonic, repr=False)
+    sleep: object = field(default=time.sleep, repr=False)
+
+
+class VirtualClock:
+    """Deterministic test clock: ``sleep`` advances ``now`` instantly."""
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+
+    def now(self) -> float:
+        return self._t
+
+    def sleep(self, seconds: float) -> None:
+        self._t += max(0.0, float(seconds))
+
+    def advance(self, seconds: float) -> None:
+        self._t += float(seconds)
 
 
 class NonFiniteError(ArithmeticError):
@@ -121,16 +150,22 @@ class RetryPolicy:
     max_delay_s: float = 2.0
     retry_on: tuple = (FailureKind.RUNTIME,)
     sleep: object = field(default=time.sleep, repr=False)
+    # when set, the clock's sleep wins over ``sleep`` — callers that already
+    # hold an injectable Clock/VirtualClock pass it straight through
+    clock: object = field(default=None, repr=False)
 
     def delays(self) -> list[float]:
         return [min(self.base_delay_s * self.multiplier ** i,
                     self.max_delay_s) for i in range(self.max_retries)]
 
+    def _sleep(self, seconds: float) -> None:
+        (self.clock.sleep if self.clock is not None else self.sleep)(seconds)
+
     def run(self, fn, op: str = "retry"):
         last = None
         for attempt, delay in enumerate([0.0] + self.delays()):
             if delay:
-                self.sleep(delay)
+                self._sleep(delay)
             try:
                 return fn()
             except Exception as e:  # noqa: BLE001 — classify, then decide
@@ -167,8 +202,99 @@ class FallbackResult:
         return bool(self.failures)
 
 
+@dataclass
+class _BreakerState:
+    state: str = "closed"       # closed | open | half-open
+    failures: int = 0           # consecutive classified failures
+    opened_at: float = 0.0
+    transitions: int = 0        # total open events (observability)
+
+
+class CircuitBreaker:
+    """Per-(op, rung) circuit breaker layered on the fallback ladder.
+
+    A rung that keeps failing burns a full classify-and-demote cycle on
+    every request.  The breaker remembers: after ``threshold`` consecutive
+    classified failures of ``(op, rung)`` the circuit *opens* and
+    ``with_fallback`` routes around the rung without executing it (a
+    ``rung-failed`` event with kind ``breaker_open``, not an exception).
+    After ``cooldown_s`` (on the injectable clock) the next request is
+    admitted as a *half-open probe*: success closes the circuit and the
+    rung serves again, failure re-opens it for another cooldown.  While a
+    probe is the admitted call, concurrent requests keep routing around —
+    one probe at a time.
+
+    Only execution failures trip the breaker; a conformance-gate rejection
+    is deterministic and already cached by ``core/conformance.py``, so
+    counting it here would be redundant.  State transitions emit
+    ``breaker-open`` / ``breaker-half-open`` / ``breaker-close`` events
+    and ``breaker.<transition>`` counters, so SLO reports and
+    ``trace summary`` show the full arc.
+    """
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0,
+                 clock: Clock | None = None):
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock if clock is not None else Clock()
+        self._states: dict[tuple[str, str], _BreakerState] = {}
+
+    def _st(self, op: str, rung: str) -> _BreakerState:
+        return self._states.setdefault((op, rung), _BreakerState())
+
+    def state(self, op: str, rung: str) -> str:
+        return self._st(op, rung).state
+
+    def allow(self, op: str, rung: str) -> bool:
+        """May this call execute ``(op, rung)``?  Advances open->half-open
+        when the cooldown has elapsed (the admitted call is the probe)."""
+        st = self._st(op, rung)
+        if st.state == "closed":
+            return True
+        if st.state == "open":
+            if self._clock.now() - st.opened_at >= self.cooldown_s:
+                st.state = "half-open"
+                metrics.counter("breaker.half_open").inc()
+                record_event("breaker-half-open", op=op, rung=rung)
+                return True
+            return False
+        # half-open: a probe is already in flight this cycle
+        return False
+
+    def record_failure(self, op: str, rung: str, kind: FailureKind) -> None:
+        st = self._st(op, rung)
+        if st.state == "half-open":
+            # failed probe: straight back to open, fresh cooldown
+            st.state = "open"
+            st.opened_at = self._clock.now()
+            st.transitions += 1
+            metrics.counter("breaker.open").inc()
+            record_event("breaker-open", op=op, rung=rung,
+                         failures=st.failures, kind=kind.value)
+            return
+        st.failures += 1
+        if st.state == "closed" and st.failures >= self.threshold:
+            st.state = "open"
+            st.opened_at = self._clock.now()
+            st.transitions += 1
+            metrics.counter("breaker.open").inc()
+            record_event("breaker-open", op=op, rung=rung,
+                         failures=st.failures, kind=kind.value)
+
+    def record_success(self, op: str, rung: str) -> None:
+        st = self._st(op, rung)
+        if st.state == "half-open":
+            record_event("breaker-close", op=op, rung=rung)
+            metrics.counter("breaker.close").inc()
+        st.state = "closed"
+        st.failures = 0
+
+
 def with_fallback(op: str, ladder, policy: RetryPolicy | None = None,
-                  gate=None) -> FallbackResult:
+                  gate=None, breaker: CircuitBreaker | None = None,
+                  ) -> FallbackResult:
     """Run the first rung of ``ladder`` (a sequence of ``(name, thunk)``)
     that succeeds, demoting down the ladder on failure.
 
@@ -179,7 +305,10 @@ def with_fallback(op: str, ladder, policy: RetryPolicy | None = None,
     the fault plan (``maybe_fail(f"{op}.{name}")`` — an injected failure
     demotes exactly like a real one), then the thunk runs (under
     ``policy`` when given, which retries transient RUNTIME failures
-    *within* the rung before demoting).  Each failed rung emits a
+    *within* the rung before demoting).  A ``breaker`` (``CircuitBreaker``)
+    is consulted before everything: a rung with an open circuit is routed
+    around without executing (kind ``breaker_open``), and execution
+    successes/failures feed its state machine.  Each failed rung emits a
     structured ``rung-failed`` event; the serving rung emits ``served``
     with ``demoted`` and the failure list, so capture logs show which
     kernel actually handled the request.  All-rungs-failed raises
@@ -188,6 +317,18 @@ def with_fallback(op: str, ladder, policy: RetryPolicy | None = None,
     failures: list[RungFailure] = []
     last: Exception | None = None
     for name, thunk in ladder:
+        if breaker is not None and not breaker.allow(op, name):
+            # open circuit: route around without executing — cheaper than a
+            # guaranteed failure, and NOT counted as a fallback demotion
+            # (nothing ran, nothing failed)
+            failures.append(RungFailure(
+                name, FailureKind.BREAKER_OPEN, "BreakerOpen",
+                "circuit open for this rung; routed to next rung"))
+            metrics.counter("breaker.skipped").inc()
+            record_event("rung-failed", op=op, rung=name,
+                         kind=FailureKind.BREAKER_OPEN.value,
+                         error="BreakerOpen")
+            continue
         if gate is not None:
             try:
                 admitted = gate(name)
@@ -221,8 +362,12 @@ def with_fallback(op: str, ladder, policy: RetryPolicy | None = None,
             metrics.counter("fallback.demotions").inc()
             record_event("rung-failed", op=op, rung=name, kind=kind.value,
                          error=type(e).__name__)
+            if breaker is not None:
+                breaker.record_failure(op, name, kind)
             last = e
             continue
+        if breaker is not None:
+            breaker.record_success(op, name)
         metrics.counter(f"served.{op}.{name}").inc()
         record_event("served", op=op, rung=name, demoted=bool(failures),
                      failed_rungs=[f.rung for f in failures])

@@ -107,6 +107,14 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "gang-launch": ("incarnation", "world", "coordinator"),
     "gang-exit": ("incarnation", "rc"),
     "heartbeat": ("rank", "step"),
+    # circuit breaker (core/resilience.py)
+    "breaker-open": ("op", "rung", "failures", "kind"),
+    "breaker-half-open": ("op", "rung"),
+    "breaker-close": ("op", "rung"),
+    # serving front end (serve/server.py)
+    "queue-shed": ("op", "reason", "depth"),
+    "deadline-shed": ("op", "rid", "late_ms"),
+    "batch-executed": ("op", "shape_class", "size", "occupancy"),
     # telemetry itself
     "span-begin": ("span", "id", "parent"),
     "span-end": ("span", "id", "parent", "ms"),

@@ -123,16 +123,24 @@ class GangSupervisor:
     in its first collective) is caught by the same clock.
     """
 
-    def __init__(self, hb_dir: str, num_ranks: int, stall_timeout: float):
+    def __init__(self, hb_dir: str, num_ranks: int, stall_timeout: float,
+                 clock=None):
         self.hb_dir = hb_dir
         self.num_ranks = int(num_ranks)
         self.stall_timeout = float(stall_timeout)
+        # injectable time source (core.resilience.Clock protocol) so stall
+        # budgets are testable without wall-clock sleeps
+        if clock is None:
+            from ..core.resilience import Clock
+
+            clock = Clock()
+        self.clock = clock
         self.reset()
 
     def reset(self) -> None:
         """New gang incarnation: restart every rank's progress clock and
         drop stale beats from the previous incarnation."""
-        now = time.monotonic()
+        now = self.clock.now()
         self._progress = {r: (None, now) for r in range(self.num_ranks)}
         for r in range(self.num_ranks):
             try:
@@ -147,7 +155,7 @@ class GangSupervisor:
     def stalled(self) -> list[dict]:
         """Ranks whose step counter is frozen past the stall budget:
         ``[{rank, step, stalled_s}]``."""
-        now = time.monotonic()
+        now = self.clock.now()
         out = []
         for rank in range(self.num_ranks):
             step = self.step_of(rank)

@@ -77,6 +77,12 @@ def _trace(argv: list[str]) -> int:
     return trace_cli.main(argv)
 
 
+def _serve(argv: list[str]) -> int:
+    from . import serve
+
+    return serve.main(argv)
+
+
 WORKLOADS: dict[str, Workload] = {
     w.name: w
     for w in (
@@ -98,6 +104,12 @@ WORKLOADS: dict[str, Workload] = {
         Workload("trace", "telemetry", "summary | timeline | merge | "
                  "export (Perfetto) | regress over CME213_TRACE_FILE "
                  "JSON-lines traces and bench artifacts", _trace),
+        # not a reference workload: the multi-tenant front end serving
+        # the workloads above as a request population (bounded queue,
+        # shape-class batching, deadlines, breaker, degradation)
+        Workload("serve", "serving", "loadgen: drive the bounded-queue "
+                 "batching front end with synthetic load, print an SLO "
+                 "report", _serve),
     )
 }
 

@@ -53,6 +53,36 @@ def shift_cipher_packed(data: jnp.ndarray, shift, width: int = 4) -> jnp.ndarray
 
 
 @jax.jit
+def shift_cipher_batched(data: jnp.ndarray, shifts: jnp.ndarray) -> jnp.ndarray:
+    """B same-length shifts in one program: ``data`` is a (B, n) uint8
+    stack, ``shifts`` a (B,) vector — each lane is the exact
+    ``shift_cipher`` expression under ``jax.vmap``, so per-lane output is
+    bitwise-equal to the serial op (integer arithmetic; no rounding to
+    worry about either way)."""
+    assert data.dtype == jnp.uint8
+    return jax.vmap(lambda d, s: d + jnp.asarray(s, jnp.uint8))(data, shifts)
+
+
+@partial(jax.jit, static_argnames=("width",))
+def shift_cipher_packed_batched(data: jnp.ndarray, shifts: jnp.ndarray,
+                                width: int = 4) -> jnp.ndarray:
+    """Batched form of the packed-lane shift: (B, n) stack, per-lane
+    shift, n divisible by ``width``."""
+    assert data.dtype == jnp.uint8
+
+    def one(d, s):
+        packed = lax.bitcast_convert_type(
+            d.reshape(-1, width // 4, 4), jnp.uint32)
+        rep = jnp.zeros((), jnp.uint32)
+        for k in range(4):
+            rep = rep | (jnp.asarray(s, jnp.uint32) << (8 * k))
+        return lax.bitcast_convert_type(packed + rep, jnp.uint8).reshape(-1)
+
+    assert width in (4, 8)
+    return jax.vmap(one)(data, shifts)
+
+
+@jax.jit
 def saxpy(alpha, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     """y ← α·x + y — the canonical bandwidth-bound elementwise op (one fused
     VPU pass)."""

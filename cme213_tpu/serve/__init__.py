@@ -1,0 +1,43 @@
+"""Multi-tenant serving front end over the solver stack.
+
+The paper's solvers assume one caller with one problem; this package
+puts a front door on them that stays up under many callers: a bounded
+request queue, shape-class batching (same-class solves vmapped into one
+device program), per-request deadlines, memory-budget admission, a
+per-(op, rung) circuit breaker over the fallback ladders, and graceful
+degradation under pressure — every refusal structured, every mode shift
+visible in ``trace summary``.  See ``docs/serving.md``.
+"""
+
+from .request import (  # noqa: F401
+    ADMISSION,
+    DEADLINE,
+    FAILED,
+    OK,
+    QUEUE_FULL,
+    SHED,
+    RequestSpec,
+    SolveRequest,
+    SolveResult,
+)
+from .server import BoundedQueue, Server  # noqa: F401
+from .workloads import ADAPTERS, CipherRequest  # noqa: F401
+
+
+def main(argv: list[str]) -> int:
+    """``python -m cme213_tpu serve <subcommand>`` dispatcher."""
+    import sys
+
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m cme213_tpu serve loadgen [args...]\n\n"
+              "subcommands:\n"
+              "  loadgen   drive the server with synthetic load and print "
+              "an SLO report")
+        return 0 if argv else 2
+    if argv[0] == "loadgen":
+        from . import loadgen
+
+        return loadgen.main(argv[1:])
+    print(f"serve: unknown subcommand {argv[0]!r} (try loadgen)",
+          file=sys.stderr)
+    return 2

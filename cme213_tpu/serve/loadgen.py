@@ -1,0 +1,279 @@
+"""Deterministic load generator + SLO report for the serving front end.
+
+``python -m cme213_tpu serve loadgen`` drives a :class:`~.server.Server`
+with a synthetic request population drawn from the hw workload mix and
+reports what the paper's operator would ask of a serving tier: p50/p99
+latency, throughput, shed rate, breaker transitions, batching occupancy.
+
+Two arrival disciplines:
+
+- **closed** (default): a fixed concurrency window — submit until the
+  window is full, step, repeat.  Offered load adapts to service rate, so
+  the run is CPU-deterministic (same seed → same batches) and measures
+  steady-state behaviour: batching efficiency, latency distribution.
+- **open**: arrivals ignore completions — requests land in bursts of
+  ``--burst`` regardless of queue state.  Offered load over capacity is
+  *guaranteed* to shed, which is the point: this is the overload smoke
+  (``scripts/faultcheck.sh``) that proves backpressure refuses the
+  excess instead of melting.
+
+Fault clauses compose naturally: run under ``CME213_FAULTS=
+"fail:serve.cipher.packed:1:4"`` and the report's ``breaker`` section
+shows the open/half-open/close transitions; ``slow:serve.heat:50``
+stretches the latency tail.  ``--baseline`` replays the same request
+sequence through a ``max_batch=1`` server and reports the batched/serial
+throughput ratio — the serving tier's reason to exist, measured.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+from ..core import metrics
+from ..core.resilience import Clock
+from .request import OK, SHED, FAILED, RequestSpec
+from .server import Server
+
+#: ops the ``--mix`` flag accepts, comma-separated
+MIX_OPS = ("spmv", "heat", "cipher")
+
+
+def build_mix(mix: str, requests: int, seed: int = 0,
+              deadline_ms: float | None = None) -> list[RequestSpec]:
+    """The synthetic request population: ``requests`` specs cycling
+    through the ops named in ``mix``, shapes chosen so that same-op
+    requests recur in a handful of shape classes (batching has something
+    to coalesce) without being identical payloads."""
+    ops = [o.strip() for o in mix.split(",") if o.strip()]
+    unknown = [o for o in ops if o not in MIX_OPS]
+    if unknown:
+        raise ValueError(f"unknown mix op(s) {unknown} (choose from {MIX_OPS})")
+    rng = np.random.default_rng(seed)
+    specs: list[RequestSpec] = []
+    for i in range(requests):
+        op = ops[i % len(ops)]
+        if op == "spmv":
+            from ..apps.spmv_scan import generate_problem
+
+            n = (512, 1024)[(i // len(ops)) % 2]  # two shape classes
+            prob = generate_problem(n, p=max(2, n // 64), q=n // 2,
+                                    iters=6, seed=seed + i)
+            specs.append(RequestSpec("spmv_scan", prob,
+                                     deadline_ms=deadline_ms))
+        elif op == "heat":
+            from ..config import SimParams
+
+            params = SimParams(nx=24, ny=24, order=2, iters=4,
+                               alpha=float(rng.uniform(0.5, 2.0)))
+            specs.append(RequestSpec("heat", params,
+                                     deadline_ms=deadline_ms))
+        else:
+            from .workloads import CipherRequest
+
+            text = rng.integers(0, 200, size=4096).astype(np.uint8)
+            specs.append(RequestSpec(
+                "cipher", CipherRequest(text, int(rng.integers(0, 56))),
+                deadline_ms=deadline_ms))
+    return specs
+
+
+def run_load(server: Server, specs: list[RequestSpec],
+             mode: str = "closed", concurrency: int = 8,
+             burst: int = 16, clock: Clock | None = None) -> dict:
+    """Drive ``server`` with ``specs`` under the chosen arrival
+    discipline; returns ``{"results": [...], "elapsed_s": float}``."""
+    clock = clock if clock is not None else server.clock
+    results = []
+    t0 = clock.now()
+    if mode == "closed":
+        pending = list(specs)
+        inflight = 0
+        while pending or inflight:
+            while pending and inflight < concurrency:
+                spec = pending.pop(0)
+                out = server.submit(spec.op, spec.payload,
+                                    deadline_ms=spec.deadline_ms)
+                if isinstance(out, int):
+                    inflight += 1
+                else:
+                    results.append(out)  # shed at submit
+            stepped = server.step()
+            inflight -= len(stepped)
+            results.extend(stepped)
+    elif mode == "open":
+        pending = list(specs)
+        while pending:
+            for spec in pending[:burst]:
+                out = server.submit(spec.op, spec.payload,
+                                    deadline_ms=spec.deadline_ms)
+                if not isinstance(out, int):
+                    results.append(out)
+            pending = pending[burst:]
+            results.extend(server.step())  # one service slot per burst
+        results.extend(server.drain())
+    else:
+        raise ValueError(f"unknown mode {mode!r} (closed | open)")
+    return {"results": results, "elapsed_s": clock.now() - t0}
+
+
+def slo_report(run: dict, before: dict, after: dict) -> dict:
+    """The SLO view of a :func:`run_load` run: latency percentiles over
+    served requests, throughput, shed accounting, breaker transitions —
+    computed from the results plus the metrics-registry delta (the same
+    numbers ``trace summary`` reads from the trace file)."""
+    results = run["results"]
+    served = [r for r in results if r.status == OK]
+    shed = [r for r in results if r.status == SHED]
+    failed = [r for r in results if r.status == FAILED]
+    lat = sorted(r.latency_ms for r in served if r.latency_ms is not None)
+
+    def pct(q):
+        if not lat:
+            return None
+        return round(lat[min(len(lat) - 1, max(0, round(q * (len(lat) - 1))))], 3)
+
+    d = metrics.delta(before, after)
+    counters = d["counters"]
+    shed_by_reason: dict[str, int] = {}
+    for r in shed:
+        shed_by_reason[r.reason] = shed_by_reason.get(r.reason, 0) + 1
+    elapsed = run["elapsed_s"]
+    sizes = [r.batch_size for r in served if r.batch_size]
+    return {
+        "requests": len(results),
+        "served": len(served),
+        "shed": len(shed),
+        "failed": len(failed),
+        "shed_rate": round(len(shed) / len(results), 4) if results else 0.0,
+        "shed_by_reason": shed_by_reason,
+        "latency_ms": {"p50": pct(0.50), "p90": pct(0.90), "p99": pct(0.99),
+                       "max": round(lat[-1], 3) if lat else None},
+        "elapsed_s": round(elapsed, 4),
+        "throughput_rps": (round(len(served) / elapsed, 2)
+                           if elapsed > 0 else None),
+        "batches": counters.get("serve.batches", 0),
+        "batch_mean_size": (round(sum(sizes) / len(sizes), 2)
+                            if sizes else None),
+        "degraded_served": sum(1 for r in served if r.degraded),
+        "breaker": {
+            "opened": counters.get("breaker.open", 0),
+            "half_open": counters.get("breaker.half_open", 0),
+            "closed": counters.get("breaker.close", 0),
+            "skipped": counters.get("breaker.skipped", 0),
+        },
+        "demotions": counters.get("fallback.demotions", 0),
+    }
+
+
+def format_report(report: dict) -> str:
+    lines = [
+        f"requests {report['requests']}: {report['served']} served, "
+        f"{report['shed']} shed ({report['shed_rate']:.1%}), "
+        f"{report['failed']} failed",
+    ]
+    for reason, n in sorted(report["shed_by_reason"].items()):
+        lines.append(f"  shed {reason}: {n}")
+    lt = report["latency_ms"]
+    if lt["p50"] is not None:
+        lines.append(f"latency ms: p50 {lt['p50']}  p90 {lt['p90']}  "
+                     f"p99 {lt['p99']}  max {lt['max']}")
+    if report["throughput_rps"] is not None:
+        lines.append(f"throughput: {report['throughput_rps']} req/s over "
+                     f"{report['elapsed_s']} s")
+    if report["batches"]:
+        lines.append(f"batches: {report['batches']} "
+                     f"(mean size {report['batch_mean_size']})")
+    if report["degraded_served"]:
+        lines.append(f"degraded-mode served: {report['degraded_served']}")
+    br = report["breaker"]
+    if any(br.values()):
+        lines.append(f"breaker: {br['opened']} opened, {br['half_open']} "
+                     f"half-open probes, {br['closed']} closed, "
+                     f"{br['skipped']} requests routed around")
+    if "baseline" in report:
+        b = report["baseline"]
+        lines.append(f"baseline (max_batch=1): {b['throughput_rps']} req/s "
+                     f"-> batched speedup {b['speedup']}x")
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="serve loadgen",
+        description="drive the serving front end with synthetic load and "
+                    "print an SLO report")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop in-flight window")
+    ap.add_argument("--burst", type=int, default=16,
+                    help="open-loop arrivals per service step")
+    ap.add_argument("--capacity", type=int, default=64,
+                    help="server queue capacity")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--mix", default="spmv,heat,cipher",
+                    help=f"comma-separated ops from {MIX_OPS}")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--degrade-depth", type=int, default=None)
+    ap.add_argument("--degrade-p99-ms", type=float, default=None)
+    ap.add_argument("--breaker-threshold", type=int, default=3)
+    ap.add_argument("--breaker-cooldown-s", type=float, default=30.0)
+    ap.add_argument("--baseline", action="store_true",
+                    help="also replay through max_batch=1 and report the "
+                    "batched/serial throughput ratio")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    specs = build_mix(args.mix, args.requests, seed=args.seed,
+                      deadline_ms=args.deadline_ms)
+
+    def make_server(max_batch: int) -> Server:
+        return Server(capacity=args.capacity, max_batch=max_batch,
+                      breaker_threshold=args.breaker_threshold,
+                      breaker_cooldown_s=args.breaker_cooldown_s,
+                      degrade_depth=args.degrade_depth,
+                      degrade_p99_ms=args.degrade_p99_ms)
+
+    def run_pass(max_batch: int) -> dict:
+        return run_load(make_server(max_batch), specs, mode=args.mode,
+                        concurrency=args.concurrency, burst=args.burst)
+
+    baseline = None
+    if args.baseline:
+        # the ratio measures SERVING throughput, not compile time: warm
+        # both paths first (every batch size is its own jit shape), then
+        # compare the warmed passes — the repo's bench discipline
+        run_pass(args.max_batch)
+        run_pass(1)
+        b_run = run_pass(1)
+        b_served = [r for r in b_run["results"] if r.status == OK]
+        baseline = {"served": len(b_served),
+                    "elapsed_s": round(b_run["elapsed_s"], 4),
+                    "throughput_rps":
+                        round(len(b_served) / b_run["elapsed_s"], 2)
+                        if b_run["elapsed_s"] > 0 else None}
+
+    before = metrics.snapshot()
+    run = run_pass(args.max_batch)
+    report = slo_report(run, before, metrics.snapshot())
+    if baseline is not None:
+        speedup = None
+        if baseline["throughput_rps"] and report["throughput_rps"]:
+            speedup = round(report["throughput_rps"]
+                            / baseline["throughput_rps"], 2)
+        report["baseline"] = {**baseline, "speedup": speedup}
+
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,68 @@
+"""Request/result types for the serving front end.
+
+A request names a workload op (``spmv_scan`` / ``heat`` / ``cipher``),
+carries an op-specific payload, and optionally a relative deadline.  A
+result is either served (``ok``), refused with a structured reason
+(``shed`` — the 429 analog: the caller can retry, back off, or route
+elsewhere, instead of hanging on unbounded latency), or failed (every
+rung of the op's ladder raised).  Shed reasons:
+
+- ``queue-full``  — bounded-queue backpressure: the queue was at
+  capacity when the request arrived;
+- ``deadline``    — the request could not *start* before its deadline
+  (rejected before execution — never executed late and discarded);
+- ``admission``   — even a single-request program for this shape class
+  exceeds the memory budget (``core/admission.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+#: result statuses
+OK = "ok"
+SHED = "shed"
+FAILED = "failed"
+
+#: shed reasons (the ``serve.shed.<reason>`` counter suffixes)
+QUEUE_FULL = "queue-full"
+DEADLINE = "deadline"
+ADMISSION = "admission"
+
+
+@dataclass
+class SolveRequest:
+    rid: int                      # server-assigned, unique per server
+    op: str                       # workload adapter name
+    payload: object               # op-specific problem description
+    submitted_s: float            # server-clock time of acceptance
+    deadline_s: float | None = None   # absolute server-clock deadline
+
+
+@dataclass
+class SolveResult:
+    rid: int
+    op: str
+    status: str                   # OK | SHED | FAILED
+    reason: str | None = None     # shed reason / failure summary
+    value: object = None          # op-specific result (OK only)
+    rung: str | None = None       # kernel rung that served (OK only)
+    shape_class: str | None = None
+    latency_ms: float | None = None   # submit -> completion (server clock)
+    batch_size: int | None = None     # lanes in the serving program
+    degraded: bool = False            # served under degraded mode
+
+    @property
+    def ok(self) -> bool:
+        return self.status == OK
+
+
+@dataclass
+class RequestSpec:
+    """A loadgen-side request description: what to submit, before the
+    server assigns it an id."""
+
+    op: str
+    payload: object
+    deadline_ms: float | None = None
+    tags: dict = field(default_factory=dict)

@@ -1,0 +1,300 @@
+"""The serving core: bounded queue, shape-class batcher, deadline-aware
+scheduler — built to stay up and degrade predictably when traffic
+exceeds capacity.
+
+Control flow is synchronous and deterministic (the property every test
+and faultcheck step leans on): ``submit`` either enqueues and returns a
+request id, or refuses immediately with a structured shed result;
+``step`` forms ONE batch from the queue head's (op, shape-class) bucket
+and executes it through the resilience stack.  Every robustness decision
+is observable:
+
+- **backpressure**: the queue is bounded; an arrival past capacity is
+  shed with a ``queue-shed`` event + ``serve.shed.queue-full`` counter
+  and a 429-style result — bounded queueing delay for everyone admitted,
+  an honest refusal for everyone else.
+- **deadlines**: a request that cannot *start* before its deadline is
+  rejected before execution (``deadline-shed`` + ``serve.shed.deadline``)
+  — device minutes are never spent on an answer nobody is waiting for.
+  Deadlines bound queue wait, not execution: a batch that *starts* in
+  time serves even if it finishes past the mark (latency says so).
+- **circuit breaking**: rung failures feed a per-(op, rung)
+  ``core.resilience.CircuitBreaker``; an open circuit routes requests to
+  the fallback rung without burning a failure per request, and a
+  half-open probe restores the rung when it heals.
+- **graceful degradation**: when queue depth (or latency p99) crosses
+  its threshold the scheduler switches to the degraded rung ladder and
+  coarser (power-of-two-padded) shape buckets, and wraps batch execution
+  in a ``degraded-mode`` span — the trade shows up in ``trace summary``,
+  not just in the latency distribution.  Exit has hysteresis (half the
+  entry depth) so the mode doesn't flap.
+- **admission**: with a memory budget set (``CME213_MEMORY_BUDGET``),
+  batch sizes are preflighted (``core.admission.admit_batch``) and
+  shrink before dispatch; overflow requests stay queued, and a shape
+  class whose single-request program cannot fit is shed with reason
+  ``admission``.
+
+All timing runs on an injectable ``core.resilience.Clock``; with a
+``VirtualClock`` the entire deadline/breaker/straggler machinery is
+testable without a single wall-clock sleep (``slow:`` fault clauses
+advance the same clock).
+"""
+
+from __future__ import annotations
+
+import itertools
+from contextlib import nullcontext
+
+from ..core import admission, metrics
+from ..core.errors import FrameworkError
+from ..core.faults import maybe_slow
+from ..core.resilience import CircuitBreaker, Clock, with_fallback
+from ..core.trace import record_event, span
+from .request import (
+    ADMISSION,
+    DEADLINE,
+    FAILED,
+    OK,
+    QUEUE_FULL,
+    SHED,
+    SolveRequest,
+    SolveResult,
+)
+from .workloads import ADAPTERS
+
+
+class BoundedQueue:
+    """FIFO with a hard capacity: ``push`` refuses (returns False) at
+    capacity instead of growing — the arrival being refused is the
+    *newest* one, so admitted requests keep their bounded wait."""
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._items: list[SolveRequest] = []
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def push(self, req: SolveRequest) -> bool:
+        if len(self._items) >= self.capacity:
+            return False
+        self._items.append(req)
+        return True
+
+    def peek(self) -> SolveRequest | None:
+        return self._items[0] if self._items else None
+
+    def take(self, reqs: list[SolveRequest]) -> None:
+        """Remove the given requests (batch formation / deadline sweep)."""
+        drop = {id(r) for r in reqs}
+        self._items = [r for r in self._items if id(r) not in drop]
+
+    def items(self) -> list[SolveRequest]:
+        return list(self._items)
+
+
+class Server:
+    """The multi-tenant front end; see the module docstring for the
+    semantics of each knob."""
+
+    def __init__(self, capacity: int = 64, max_batch: int = 8,
+                 clock: Clock | None = None,
+                 breaker: CircuitBreaker | None = None,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 30.0,
+                 degrade_depth: int | None = None,
+                 degrade_p99_ms: float | None = None,
+                 adapters: dict | None = None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.clock = clock if clock is not None else Clock()
+        self.queue = BoundedQueue(capacity)
+        self.max_batch = max_batch
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            threshold=breaker_threshold, cooldown_s=breaker_cooldown_s,
+            clock=self.clock)
+        self.degrade_depth = degrade_depth
+        self.degrade_p99_ms = degrade_p99_ms
+        self.degraded = False
+        self._degrade_reason: str | None = None
+        self.adapters = adapters if adapters is not None else dict(ADAPTERS)
+        self._rids = itertools.count()
+        self._admit_cache: dict[tuple, int] = {}
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, op: str, payload, deadline_ms: float | None = None):
+        """Accept (returns the request id) or refuse (returns a SHED
+        :class:`SolveResult`) — never blocks, never queues unboundedly."""
+        if op not in self.adapters:
+            raise ValueError(f"unknown op {op!r} "
+                             f"(serving: {sorted(self.adapters)})")
+        metrics.counter("serve.requests").inc()
+        now = self.clock.now()
+        rid = next(self._rids)
+        if deadline_ms is not None and deadline_ms <= 0:
+            return self._shed_deadline(
+                SolveRequest(rid, op, payload, now, now), late_ms=-deadline_ms)
+        req = SolveRequest(
+            rid, op, payload, submitted_s=now,
+            deadline_s=None if deadline_ms is None else now + deadline_ms / 1e3)
+        if not self.queue.push(req):
+            metrics.counter(f"serve.shed.{QUEUE_FULL}").inc()
+            record_event("queue-shed", op=op, reason=QUEUE_FULL,
+                         depth=len(self.queue))
+            return SolveResult(rid, op, SHED, reason=QUEUE_FULL)
+        return rid
+
+    def _shed_deadline(self, req: SolveRequest, late_ms: float) -> SolveResult:
+        metrics.counter(f"serve.shed.{DEADLINE}").inc()
+        record_event("deadline-shed", op=req.op, rid=req.rid,
+                     late_ms=round(late_ms, 3))
+        return SolveResult(req.rid, req.op, SHED, reason=DEADLINE)
+
+    # -------------------------------------------------------------- step
+
+    def step(self) -> list[SolveResult]:
+        """Sweep expired deadlines, then form and execute ONE batch from
+        the queue head's (op, shape-class) bucket.  Returns every result
+        produced this step (shed and served)."""
+        results: list[SolveResult] = []
+        now = self.clock.now()
+
+        expired = [r for r in self.queue.items()
+                   if r.deadline_s is not None and now >= r.deadline_s]
+        if expired:
+            self.queue.take(expired)
+            results.extend(
+                self._shed_deadline(r, late_ms=(now - r.deadline_s) * 1e3)
+                for r in expired)
+
+        self._update_degraded()
+        head = self.queue.peek()
+        if head is None:
+            return results
+
+        adapter = self.adapters[head.op]
+        coarse = self.degraded
+        key = adapter.shape_class(head.payload, coarse=coarse)
+        batch = [r for r in self.queue.items()
+                 if r.op == head.op
+                 and adapter.shape_class(r.payload, coarse=coarse) == key]
+        batch = batch[:self.max_batch]
+
+        batch, admission_shed = self._admit(adapter, key, batch, coarse)
+        results.extend(admission_shed)
+        if not batch:
+            return results
+        self.queue.take(batch)
+        results.extend(self._execute(adapter, key, batch, coarse))
+        return results
+
+    def drain(self) -> list[SolveResult]:
+        """Step until the queue is empty."""
+        results: list[SolveResult] = []
+        while len(self.queue):
+            results.extend(self.step())
+        return results
+
+    # ---------------------------------------------------------- internals
+
+    def _admit(self, adapter, key: str, batch, coarse):
+        """Memory-budget preflight: shrink the batch to the admitted
+        size (overflow stays queued), or shed the whole bucket when even
+        one request cannot fit."""
+        if not batch or admission.memory_budget() is None:
+            return batch, []
+        rung = adapter.rungs(self.degraded)[0]
+        builder = adapter.preflight_builder(
+            [r.payload for r in batch], rung, coarse=coarse)
+        if builder is None:
+            return batch, []
+        cache_key = (adapter.op, key, rung, len(batch))
+        admitted = self._admit_cache.get(cache_key)
+        if admitted is None:
+            try:
+                admitted = admission.admit_batch(
+                    f"serve.{adapter.op}", len(batch), builder)
+            except admission.AdmissionError:
+                self.queue.take(batch)
+                shed = []
+                for r in batch:
+                    metrics.counter(f"serve.shed.{ADMISSION}").inc()
+                    record_event("queue-shed", op=r.op, reason=ADMISSION,
+                                 depth=len(self.queue))
+                    shed.append(SolveResult(r.rid, r.op, SHED,
+                                            reason=ADMISSION))
+                return [], shed
+            self._admit_cache[cache_key] = admitted
+        return batch[:admitted], []
+
+    def _execute(self, adapter, key: str, batch, coarse) -> list[SolveResult]:
+        op = adapter.op
+        payloads = [r.payload for r in batch]
+        rungs = adapter.rungs(self.degraded)
+        ladder = [(rung,
+                   (lambda rg: lambda: adapter.run_batch(
+                       payloads, rg, coarse=coarse))(rung))
+                  for rung in rungs]
+        # injected straggler latency rides the server clock, so it shows
+        # up in latencies and subsequent deadline decisions exactly like
+        # a real slow device
+        maybe_slow(f"serve.{op}", sleep=self.clock.sleep)
+        ctx = (span("degraded-mode", op=op,
+                    reason=self._degrade_reason or "pressure")
+               if self.degraded else nullcontext())
+        try:
+            with ctx:
+                res = with_fallback(f"serve.{op}", ladder,
+                                    breaker=self.breaker)
+        except FrameworkError as e:
+            metrics.counter("serve.failed").inc(len(batch))
+            return [SolveResult(r.rid, op, FAILED, reason=str(e)[:200],
+                                shape_class=key, batch_size=len(batch),
+                                degraded=self.degraded) for r in batch]
+        end = self.clock.now()
+        occupancy = len(batch) / self.max_batch
+        metrics.counter("serve.batches").inc()
+        metrics.histogram("serve.batch.size").observe(len(batch))
+        record_event("batch-executed", op=op, shape_class=key,
+                     size=len(batch), occupancy=round(occupancy, 4))
+        out = []
+        for r, value in zip(batch, res.value):
+            latency_ms = (end - r.submitted_s) * 1e3
+            metrics.histogram("serve.latency.ms").observe(latency_ms)
+            metrics.histogram(f"serve.latency.{op}.ms").observe(latency_ms)
+            out.append(SolveResult(
+                r.rid, op, OK, value=value, rung=res.rung, shape_class=key,
+                latency_ms=latency_ms, batch_size=len(batch),
+                degraded=self.degraded))
+        return out
+
+    def _update_degraded(self) -> None:
+        depth = len(self.queue)
+        p99 = metrics.histogram("serve.latency.ms").percentile(0.99)
+        reason = None
+        if self.degrade_depth is not None and depth >= self.degrade_depth:
+            reason = "queue-depth"
+        elif (self.degrade_p99_ms is not None and p99 is not None
+              and p99 >= self.degrade_p99_ms):
+            reason = "latency-p99"
+        if not self.degraded:
+            if reason is not None:
+                self.degraded = True
+                self._degrade_reason = reason
+                metrics.gauge("serve.degraded").set(1)
+            return
+        # hysteresis: leave only once depth has fallen to half the entry
+        # threshold (and p99, if it triggered, has come back under) — the
+        # latency ring decays slowly, so depth is the primary exit signal
+        depth_ok = (self.degrade_depth is None
+                    or depth <= self.degrade_depth // 2)
+        p99_ok = (self.degrade_p99_ms is None or p99 is None
+                  or p99 < self.degrade_p99_ms
+                  or self._degrade_reason != "latency-p99")
+        if depth_ok and p99_ok and reason is None:
+            self.degraded = False
+            self._degrade_reason = None
+            metrics.gauge("serve.degraded").set(0)

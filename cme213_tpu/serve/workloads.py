@@ -1,0 +1,184 @@
+"""Workload adapters: how each solver batches, buckets, and degrades.
+
+The request population is the paper's hw workload mix — heat grids
+(hw2/hw5), SpMV-scan problems (hw_final), shift-cipher cracks (hw1) —
+and each adapter maps its payload type onto the serving layer's four
+needs:
+
+- **shape-class keying** (``shape_class``): requests whose jitted
+  program would be identical share a bucket, using the same keys the
+  conformance cache uses (``core/conformance.py``) — spmv by
+  ``n/iters``, heat by padded grid shape/order/iters, cipher by byte
+  length.  ``coarse=True`` is the degraded-mode keying: spmv rounds
+  ``n`` up to the next power of two (requests are zero-padded with a
+  quarantined tail segment — ``apps.spmv_scan.pad_problem`` — so
+  near-sized classes merge into one program and the compile-cache stops
+  fragmenting under pressure); heat and cipher classes are exact by
+  construction (padding a grid would move its physical boundary).
+- **batched execution** (``run_batch``): all payloads of one bucket run
+  as ONE device program via the apps' vmap/stacking entry points, each
+  lane bitwise-equal to its serial solve.
+- **rung ladders** (``rungs``): the kernel candidates ``with_fallback``
+  walks, per mode.  Degraded mode serves from the always-conformant
+  reference rung only (no probes, no extra compile classes — predictable
+  over peak-fast).
+- **admission preflight** (``preflight_builder``): a ``size ->
+  Decision`` closure over the batched program, for
+  ``core/admission.admit_batch`` when a memory budget is set.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+@dataclass
+class CipherRequest:
+    """A shift-cipher solve: encrypt/decrypt ``text`` by ``shift``."""
+
+    text: np.ndarray        # (n,) uint8
+    shift: int
+
+
+class SpmvAdapter:
+    """``apps.spmv_scan.Problem`` payloads; XLA scan rungs only (the
+    Pallas rungs don't stack — interpret mode on CPU would dominate any
+    batching win, and serving wants predictable latency)."""
+
+    op = "spmv_scan"
+
+    def shape_class(self, prob, coarse: bool = False) -> str:
+        n = _next_pow2(prob.n) if coarse else prob.n
+        return f"n{n}/i{prob.iters}"
+
+    def rungs(self, degraded: bool = False) -> tuple[str, ...]:
+        # blocked is the O(n) throughput rung; flat is the bitwise-stable
+        # reference every other rung is conformance-checked against, so
+        # degraded mode serves from it alone
+        return ("flat",) if degraded else ("blocked", "flat")
+
+    def run_batch(self, probs, rung: str, coarse: bool = False):
+        from ..apps.spmv_scan import pad_problem, run_spmv_scan_batched
+
+        if coarse:
+            n_to = _next_pow2(max(p.n for p in probs))
+            padded = [pad_problem(p, n_to) for p in probs]
+            outs = run_spmv_scan_batched(padded, kernel=rung)
+            return [o[:p.n] for p, o in zip(probs, outs)]
+        return run_spmv_scan_batched(list(probs), kernel=rung)
+
+    def preflight_builder(self, probs, rung: str, coarse: bool = False):
+        from ..core import admission
+        from ..apps.spmv_scan import _iterate_batched, pad_problem
+
+        import jax.numpy as jnp
+
+        p0 = probs[0] if not coarse else pad_problem(
+            probs[0], _next_pow2(max(p.n for p in probs)))
+        n, iters = p0.n, p0.iters
+
+        def preflight_at(size: int) -> admission.Decision:
+            z = jnp.zeros((size, n), jnp.float32)
+            fl = jnp.zeros((size, n), jnp.int32)
+            return admission.preflight(
+                _iterate_batched, z, z, fl, op=f"serve.{self.op}",
+                iters=iters, scan=rung)
+
+        return preflight_at
+
+
+class HeatAdapter:
+    """``config.SimParams`` payloads — the initial grid is derived from
+    the params the way the reference's driver built it, and CFL factors
+    ride as vmapped per-lane scalars (so requests need not share
+    diffusivity to share a bucket)."""
+
+    op = "heat"
+
+    def shape_class(self, params, coarse: bool = False) -> str:
+        return f"{params.gy}x{params.gx}/order{params.order}/i{params.iters}"
+
+    def rungs(self, degraded: bool = False) -> tuple[str, ...]:
+        # one conformant rung: the XLA stencil (the Pallas pipeline runs
+        # interpreted off-TPU — never the serving choice there, and
+        # batching it is ROADMAP work, not this layer's)
+        return ("xla",)
+
+    def run_batch(self, params_list, rung: str, coarse: bool = False):
+        from ..apps.heat2d import run_heat_batched
+        from ..grid import make_initial_grid
+
+        if rung != "xla":
+            raise ValueError(f"unknown heat rung {rung!r}")
+        p0 = params_list[0]
+        grids = [np.asarray(make_initial_grid(p)) for p in params_list]
+        return run_heat_batched(grids, p0.iters, p0.order,
+                                [p.xcfl for p in params_list],
+                                [p.ycfl for p in params_list])
+
+    def preflight_builder(self, params_list, rung: str,
+                          coarse: bool = False):
+        from ..core import admission
+        from ..apps.heat2d import _heat_batched
+
+        import jax.numpy as jnp
+
+        p0 = params_list[0]
+
+        def preflight_at(size: int) -> admission.Decision:
+            z = jnp.zeros((size, p0.gy, p0.gx), jnp.float32)
+            c = jnp.zeros((size,), jnp.float32)
+            return admission.preflight(
+                _heat_batched, z, p0.iters, p0.order, c, c,
+                op=f"serve.{self.op}")
+
+        return preflight_at
+
+
+class CipherAdapter:
+    """:class:`CipherRequest` payloads.  Two bitwise-identical rungs —
+    ``packed`` (4-bytes-per-lane, the reference's uint kernel) and
+    ``bytes`` (plain per-byte) — which is what makes this op the breaker
+    demonstration: a ``fail:serve.cipher.packed``-injected rung opens its
+    circuit and the ``bytes`` rung serves bitwise-equal results."""
+
+    op = "cipher"
+
+    def shape_class(self, req: CipherRequest, coarse: bool = False) -> str:
+        return f"n{req.text.shape[0]}/u8"
+
+    def rungs(self, degraded: bool = False) -> tuple[str, ...]:
+        return ("packed", "bytes")
+
+    def run_batch(self, reqs, rung: str, coarse: bool = False):
+        import jax.numpy as jnp
+
+        from ..ops.elementwise import (
+            shift_cipher_batched,
+            shift_cipher_packed_batched,
+        )
+
+        data = jnp.asarray(np.stack([r.text for r in reqs]))
+        shifts = jnp.asarray(np.array([r.shift for r in reqs],
+                                      dtype=np.int32))
+        if rung == "packed":
+            out = shift_cipher_packed_batched(data, shifts)
+        elif rung == "bytes":
+            out = shift_cipher_batched(data, shifts)
+        else:
+            raise ValueError(f"unknown cipher rung {rung!r}")
+        out = np.asarray(out)
+        return [out[i] for i in range(len(reqs))]
+
+    def preflight_builder(self, reqs, rung: str, coarse: bool = False):
+        return None  # bytes in ≈ bytes out: admission adds nothing here
+
+
+#: the default adapter registry — the hw workload mix as request types
+ADAPTERS = {a.op: a for a in (SpmvAdapter(), HeatAdapter(), CipherAdapter())}

@@ -278,6 +278,58 @@ def summarize(events: list[dict], out=None) -> dict:
         w(f"heartbeats r{rank}: {len(beats[rank])} "
           f"(last step {beats[rank][-1]})\n")
 
+    # serving front end (serve/): load shedding, breaker transitions,
+    # batch occupancy — the stays-up-under-overload evidence
+    shed = Counter()
+    for e in events:
+        if e["event"] == "queue-shed":
+            shed[(e.get("op"), e.get("reason"))] += 1
+        elif e["event"] == "deadline-shed":
+            shed[(e.get("op"), "deadline")] += 1
+    breaker = {"open": [], "half_open": [], "close": []}
+    for e in events:
+        if e["event"] == "breaker-open":
+            breaker["open"].append((e.get("op"), e.get("rung")))
+        elif e["event"] == "breaker-half-open":
+            breaker["half_open"].append((e.get("op"), e.get("rung")))
+        elif e["event"] == "breaker-close":
+            breaker["close"].append((e.get("op"), e.get("rung")))
+    batches = [e for e in events if e["event"] == "batch-executed"]
+    degraded = sum(1 for e in events if e["event"] == "span-end"
+                   and e.get("span") == "degraded-mode")
+    serving = None
+    if shed or any(breaker.values()) or batches:
+        occ = [e["occupancy"] for e in batches
+               if isinstance(e.get("occupancy"), (int, float))]
+        sizes = [e["size"] for e in batches
+                 if isinstance(e.get("size"), (int, float))]
+        serving = {
+            "shed": {f"{op}:{reason}": n
+                     for (op, reason), n in sorted(shed.items(),
+                                                   key=lambda kv: (
+                                                       str(kv[0][0]),
+                                                       str(kv[0][1])))},
+            "breaker": {k: [f"{op}.{rung}" for op, rung in v]
+                        for k, v in breaker.items()},
+            "batches": len(batches),
+            "batch_mean_size": (sum(sizes) / len(sizes)) if sizes else None,
+            "batch_occupancy": (sum(occ) / len(occ)) if occ else None,
+            "degraded_batches": degraded,
+        }
+        w(f"serving: {len(batches)} batch(es)")
+        if sizes:
+            w(f", mean size {serving['batch_mean_size']:.2f}"
+              f", occupancy {serving['batch_occupancy']:.2f}")
+        if degraded:
+            w(f", {degraded} degraded")
+        w("\n")
+        for key, n in serving["shed"].items():
+            w(f"  shed {key} x{n}\n")
+        for transition in ("open", "half_open", "close"):
+            for target in breaker[transition]:
+                w(f"  breaker {transition.replace('_', '-')}: "
+                  f"{target[0]}.{target[1]}\n")
+
     counts = Counter(e["event"] for e in events)
     for label, ev in (("op failures", "op-failure"),
                       ("retries", "retry"),
@@ -318,6 +370,7 @@ def summarize(events: list[dict], out=None) -> dict:
             "conformance": {f"{op}.{rung}": {"ok": ok, "count": n}
                             for (op, rung, ok), n in conf.items()},
             "admission": {"rejected": len(rejected), "shrunk": len(shrunk)},
+            "serving": serving,
             "counts": dict(counts)}
 
 

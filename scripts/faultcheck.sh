@@ -35,6 +35,12 @@
 #     the checkpointed heat solve shrink its chunk, retry, and complete
 #     bitwise-equal to an un-faulted run, with the chunk-shrunk event in
 #     the trace.
+# And per ISSUE 8 (serving):
+#  9. serving front end: an open-loop burst over a tiny bounded queue
+#     sheds the excess with structured queue-shed results (429 analog,
+#     accounting exact), and a fail:-poisoned kernel rung opens its
+#     circuit breaker while the fallback rung keeps serving — both
+#     verified from the SLO report AND via `trace summary --require`.
 # On ANY failing step the merged gang timeline is printed for
 # debuggability before the workspace is cleaned up.
 set -euo pipefail
@@ -55,7 +61,7 @@ on_exit() {
 }
 trap on_exit EXIT
 
-echo "== 1/8 run_all: injected sweep failure -> retry + failures.json"
+echo "== 1/9 run_all: injected sweep failure -> retry + failures.json"
 CME213_FAULTS="fail:sweep.scan_bandwidth" \
     python -m cme213_tpu.bench.run_all --quick --out "$OUT" \
     --only scan_bandwidth
@@ -67,7 +73,7 @@ assert [r["sweep"] for r in m["retried"]] == ["scan_bandwidth"], m
 print("failures.json populated:", m["retried"][0]["error"])
 PY
 
-echo "== 2/8 spmv ladder: injected pallas failure -> demoted, correct"
+echo "== 2/9 spmv ladder: injected pallas failure -> demoted, correct"
 CME213_FAULTS="fail:spmv_scan.pallas-fused" python - <<'PY'
 from cme213_tpu.apps import spmv_scan as sp
 from cme213_tpu.core import trace
@@ -80,7 +86,7 @@ assert errs["rel_l2"] < 1e-4, errs
 print("demoted to", served["rung"], "rel_l2", errs["rel_l2"])
 PY
 
-echo "== 3/8 launcher: injected rank kill survived by --max-restarts 1"
+echo "== 3/9 launcher: injected rank kill survived by --max-restarts 1"
 CME213_FAULTS="rankkill:1:0" python -m cme213_tpu.dist.launch \
     --np 2 --max-restarts 1 --timeout 120 -- \
     python -c "import os; from cme213_tpu.core import faults; \
@@ -105,7 +111,7 @@ cat > "$OUT/params_gang.in" <<'EOF'
 100.0 25.0 0.0 50.0
 EOF
 
-echo "== 4/8 supervised gang: rankkill -> gang restart + epoch-commit resume"
+echo "== 4/9 supervised gang: rankkill -> gang restart + epoch-commit resume"
 # 1 process x 2 fake devices: real halo-exchange collectives in the rank,
 # real process death, real gang supervision — works on every backend.
 # Per-rank trace sinks feed step 6's CLI gate.
@@ -127,7 +133,7 @@ print(f"gang recovery OK (final commit: epoch {m['epoch']}, "
       f"step {m['step']})")
 PY
 
-echo "== 5/8 supervised gang across 2 REAL ranks (capability-gated)"
+echo "== 5/9 supervised gang across 2 REAL ranks (capability-gated)"
 set +e
 CME213_FAULTS="rankkill:1:1" JAX_PLATFORMS= \
 CME213_TRACE_FILE="$OUT/trace5-{rank}.jsonl" python -m cme213_tpu.dist.launch \
@@ -155,7 +161,7 @@ else
   echo "2-rank gang recovery OK"
 fi
 
-echo "== 6/8 trace CLI over the per-rank gang traces (ISSUE 4)"
+echo "== 6/9 trace CLI over the per-rank gang traces (ISSUE 4)"
 # step 4's files always exist; any unparseable line exits 2, a missing
 # commit span or gang phase exits 1 — either fails the gate
 python -m cme213_tpu trace summary "$OUT"/trace4-*.jsonl \
@@ -176,7 +182,7 @@ if ls "$OUT"/trace5-*.jsonl >/dev/null 2>&1; then
       > /dev/null
 fi
 
-echo "== 7/8 conformance gate: wrong: probe poison -> demotion (ISSUE 5)"
+echo "== 7/9 conformance gate: wrong: probe poison -> demotion (ISSUE 5)"
 # the first conformance probe of spmv_scan (the requested pallas-fused
 # rung) is perturbed; the gate must demote it, the next rung (blocked,
 # probe call 2, clean) serves, and the result still passes the f64 check
@@ -205,7 +211,7 @@ if python -m cme213_tpu trace summary "$OUT/trace7.jsonl" \
   exit 1
 fi
 
-echo "== 8/8 admission: oom: -> chunk shrink, bitwise-equal completion"
+echo "== 8/9 admission: oom: -> chunk shrink, bitwise-equal completion"
 CME213_FAULTS="oom:heat_chunk:1" \
 CME213_TRACE_FILE="$OUT/trace8.jsonl" python - "$OUT" <<'PY'
 import os
@@ -226,5 +232,30 @@ print("oom chunk shrink 4->2; result bitwise-equal to un-faulted run")
 PY
 python -m cme213_tpu trace summary "$OUT/trace8.jsonl" \
     --require chunk-shrunk
+
+echo "== 9/9 serving: open-loop burst over a tiny queue sheds + breaker opens"
+# 24 cipher requests burst at a 6-deep queue: backpressure MUST shed the
+# excess with structured queue-shed events, and the fail:-poisoned packed
+# rung MUST open its circuit (3 classified failures) while the bytes rung
+# keeps serving — both findable by the --require gate.
+CME213_FAULTS="fail:serve.cipher.packed:1:4" \
+CME213_TRACE_FILE="$OUT/trace9.jsonl" \
+  python -m cme213_tpu serve loadgen --mode open --burst 24 --requests 24 \
+    --capacity 6 --max-batch 2 --mix cipher --breaker-threshold 3 \
+    --json > "$OUT/slo9.json"
+python - "$OUT/slo9.json" <<'PY'
+import json
+import sys
+rep = json.load(open(sys.argv[1]))
+assert rep["shed"] > 0, rep
+assert rep["shed_by_reason"].get("queue-full", 0) == rep["shed"], rep
+assert rep["served"] + rep["shed"] == rep["requests"], rep
+assert rep["breaker"]["opened"] >= 1, rep
+assert rep["demotions"] >= 3, rep
+print(f"overload shed {rep['shed']}/{rep['requests']}, served "
+      f"{rep['served']}, breaker opened {rep['breaker']['opened']}")
+PY
+python -m cme213_tpu trace summary "$OUT/trace9.jsonl" \
+    --require queue-shed,breaker-open
 
 echo "faultcheck OK"

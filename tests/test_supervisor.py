@@ -20,6 +20,7 @@ import numpy as np
 import pytest
 
 from cme213_tpu.core import faults, trace
+from cme213_tpu.core.resilience import VirtualClock
 from cme213_tpu.dist.supervisor import (GangSupervisor, HeartbeatWriter,
                                         heartbeat_from_env, read_heartbeat)
 
@@ -77,13 +78,15 @@ def test_missing_heartbeat_reads_none(tmp_path):
 # ------------------------------------------------------- stall detection
 
 def test_supervisor_distinguishes_progress_from_frozen(tmp_path):
-    sup = GangSupervisor(str(tmp_path), num_ranks=2, stall_timeout=0.15)
+    clock = VirtualClock()
+    sup = GangSupervisor(str(tmp_path), num_ranks=2, stall_timeout=0.15,
+                         clock=clock)
     hb0 = HeartbeatWriter(str(tmp_path), 0)
     hb1 = HeartbeatWriter(str(tmp_path), 1)
     hb0.beat(1)
     hb1.beat(1)
     assert sup.stalled() == []          # first beats: progress
-    time.sleep(0.2)
+    clock.advance(0.2)
     hb0.beat(2)                         # rank 0 advances; rank 1 frozen
     stalled = sup.stalled()
     assert [s["rank"] for s in stalled] == [1]
@@ -93,9 +96,11 @@ def test_supervisor_distinguishes_progress_from_frozen(tmp_path):
 def test_supervisor_catches_rank_that_never_beat(tmp_path):
     """A rank wedged before its first beat (hung coordinator handshake) is
     timed from gang spawn."""
-    sup = GangSupervisor(str(tmp_path), num_ranks=1, stall_timeout=0.1)
+    clock = VirtualClock()
+    sup = GangSupervisor(str(tmp_path), num_ranks=1, stall_timeout=0.1,
+                         clock=clock)
     assert sup.stalled() == []
-    time.sleep(0.15)
+    clock.advance(0.15)
     assert [s["rank"] for s in sup.stalled()] == [0]
 
 
