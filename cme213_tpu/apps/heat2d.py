@@ -143,10 +143,29 @@ def run_heat_batched(grids: list[np.ndarray], iters: int, order: int,
         if np.asarray(g).shape != shape:
             raise ValueError(
                 f"batch mixes grid shapes: {np.asarray(g).shape} vs {shape}")
+    from ..core import check_op, programs, span
+
+    b, (gy, gx) = len(grids), shape
+    shape_class = f"{gy}x{gx}/order{order}/i{iters}/b{b}"
+
+    def build():
+        return lambda u, xc, yc: _heat_batched(u, iters, order, xc, yc)
+
+    def warm(fn):
+        z = jnp.zeros((b,), jnp.float32)
+        check_op("heat_batched.xla",
+                 fn(jnp.zeros((b, gy, gx), jnp.float32), z, z))
+
+    runner = programs.get("heat_batched", "xla", shape_class, build,
+                          dtype="f32", warm=warm, iters=iters, order=order,
+                          batch=b)
     u = jnp.asarray(np.stack([np.asarray(g) for g in grids]), jnp.float32)
-    out = np.asarray(_heat_batched(
-        u, iters, order, jnp.asarray(xcfls, jnp.float32),
-        jnp.asarray(ycfls, jnp.float32)))
+    with span("heat_batched.run", kernel="xla",
+              shape_class=shape_class) as sp:
+        out = runner(u, jnp.asarray(xcfls, jnp.float32),
+                     jnp.asarray(ycfls, jnp.float32))
+        sp.block(out)
+    out = np.asarray(out)
     return [out[i] for i in range(len(grids))]
 
 

@@ -35,6 +35,7 @@ from ..ops.segmented import (
     head_flags_from_starts,
     segmented_scan,
     segmented_scan_blocked,
+    segmented_scan_dense,
     segmented_scan_flat,
     validate_segments,
 )
@@ -252,6 +253,8 @@ def run_spmv_scan_batched(probs: list[Problem], kernel: str = "flat",
             raise ValueError(
                 f"batch mixes shape classes: n{p.n}/i{p.iters} vs "
                 f"n{n}/i{iters}")
+    from ..core import check_op, programs, span
+
     a = jnp.asarray(np.stack([p.a for p in probs]), dtype)
     xx = jnp.asarray(np.stack([p.xx for p in probs]), dtype)
     # head flags built host-side in one pass: B device dispatches of
@@ -261,7 +264,28 @@ def run_spmv_scan_batched(probs: list[Problem], kernel: str = "flat",
     for i, p in enumerate(probs):
         fl[i, p.s[:-1]] = 1
     flags = jnp.asarray(fl)
-    out = np.asarray(_iterate_batched(a, xx, flags, iters, scan=kernel))
+    # the batch width is part of the compiled program, so it rides in the
+    # shape class — b4 traffic never counts as a retrace of b2 traffic
+    b = len(probs)
+    shape_class = f"n{n}/i{iters}/b{b}"
+
+    def build():
+        return lambda a, xx, flags: _iterate_batched(a, xx, flags, iters,
+                                                     scan=kernel)
+
+    def warm(fn):
+        check_op(f"spmv_scan_batched.{kernel}",
+                 fn(jnp.zeros((b, n), dtype), jnp.zeros((b, n), dtype),
+                    jnp.zeros((b, n), jnp.int32)))
+
+    runner = programs.get("spmv_scan_batched", kernel, shape_class, build,
+                          dtype=np.dtype(dtype).name, warm=warm,
+                          iters=iters, batch=b)
+    with span("spmv_scan_batched.run", kernel=kernel,
+              shape_class=shape_class) as sp:
+        out = runner(a, xx, flags)
+        sp.block(out)
+    out = np.asarray(out)
     return [out[i] for i in range(len(probs))]
 
 
@@ -274,6 +298,18 @@ def _iterate_pallas_unfused(a, xx, flags, iters: int, interpret: bool):
 
     def body(_, v):
         return segmented_scan_pallas(v * xx, flags, interpret=interpret)
+
+    return jax.lax.fori_loop(0, iters, body, a)
+
+
+@partial(jax.jit, static_argnames=("iters", "max_len"), donate_argnums=(0,))
+def _iterate_dense(a, xx, starts, iters: int, max_len: int):
+    """Dense strawman loop with the segment starts as a **traced** operand
+    — per-problem data rides as arguments so the cached program serves any
+    instance of its shape class; only ``max_len`` (padding the dense rows)
+    stays static."""
+    def body(_, v):
+        return segmented_scan_dense(v * xx, starts, max_len)
 
     return jax.lax.fori_loop(0, iters, body, a)
 
@@ -356,8 +392,15 @@ def _conformance_gate(n: int, dtype):
         flags = head_flags_from_starts(jnp.asarray(prob.s[:-1]), prob.n)
 
         def run(k):
-            return lambda: np.asarray(
-                _make_runner(prob, xx, flags, k)(jnp.asarray(prob.a, dtype)))
+            # probes compile THROUGH the program cache: gating a rung also
+            # warms its program for the probe class instead of paying a
+            # discarded throwaway compile
+            def thunk():
+                fn = _program(k, prob.n, prob.iters, dtype, p=prob.p,
+                              max_len=int(np.diff(prob.s).max()))
+                return np.asarray(fn(jnp.asarray(prob.a, dtype), xx, flags,
+                                     jnp.asarray(prob.s[:-1])))
+            return thunk
 
         return conformance.check(
             "spmv_scan", kernel, shape_class=np.dtype(dtype).name,
@@ -367,42 +410,100 @@ def _conformance_gate(n: int, dtype):
     return gate
 
 
-def _make_runner(prob: Problem, xx, flags, kernel: str):
-    """runner(v) executing all N iterations with the named kernel."""
-    import jax
-
+def _build_runner(kernel: str, iters: int, interpret: bool | None = None,
+                  max_len: int | None = None):
+    """Shape-polymorphic runner ``fn(a, xx, flags, starts)`` executing all
+    ``iters`` iterations with the named kernel.  Every per-problem array
+    is an **argument** (never closed over) so the callable can live in the
+    process-wide program cache and serve any problem in its shape class;
+    kernels that don't need ``starts`` (everything but ``dense``) ignore
+    it."""
     if kernel == "pallas-fused":
         from ..ops.segmented_pallas import spmv_scan_pallas
 
-        interpret = jax.devices()[0].platform != "tpu"
-        return lambda v: spmv_scan_pallas(v, xx, flags, prob.iters,
-                                          interpret=interpret)
+        return lambda a, xx, flags, starts: spmv_scan_pallas(
+            a, xx, flags, iters, interpret=interpret)
     if kernel == "pallas":
-        interpret = jax.devices()[0].platform != "tpu"
-        return lambda v: _iterate_pallas_unfused(v, xx, flags, prob.iters,
-                                                 interpret=interpret)
+        return lambda a, xx, flags, starts: _iterate_pallas_unfused(
+            a, xx, flags, iters, interpret=interpret)
     if kernel in _SCAN_KERNELS:
-        return lambda v: _iterate(v, xx, flags, prob.iters, scan=kernel)
+        return lambda a, xx, flags, starts: _iterate(
+            a, xx, flags, iters, scan=kernel)
     if kernel == "dense":
-        from ..ops.segmented import segmented_scan_dense
-
-        starts = jnp.asarray(prob.s[:-1])
-        max_len = int(np.diff(prob.s).max())
-
-        @partial(jax.jit, static_argnames=("iters",), donate_argnums=(0,))
-        def _iterate_dense(v, xx, iters: int):
-            def body(_, v):
-                return segmented_scan_dense(v * xx, starts, max_len)
-
-            return jax.lax.fori_loop(0, iters, body, v)
-
-        return lambda v: _iterate_dense(v, xx, prob.iters)
+        return lambda a, xx, flags, starts: _iterate_dense(
+            a, xx, starts, iters, max_len)
     raise ValueError(f"unknown kernel {kernel!r}")
+
+
+def _program(rung: str, n: int, iters: int, dtype, p: int | None = None,
+             max_len: int | None = None):
+    """The cached program for ``(rung, n{n}/i{iters}, dtype)`` — built and
+    warmed once per process (``core/programs.py``), a dict lookup ever
+    after.  The warmup runs on zero inputs of the class's shapes behind
+    the rung-named barrier, so compile/runtime failures surface inside
+    the miss's ``spmv_scan.compile`` span attributed to the rung, exactly
+    where the old per-call warmup surfaced them."""
+    from ..core import check_op, programs
+
+    static = {"iters": iters}
+    interpret = None
+    if rung in ("pallas", "pallas-fused"):
+        interpret = jax.devices()[0].platform != "tpu"
+        static["interpret"] = interpret
+    if rung == "dense":
+        # starts is traced, but its length and the dense row width change
+        # the compiled program — they key the cache, not the closure
+        static.update(p=p, max_len=max_len)
+
+    def build():
+        return _build_runner(rung, iters, interpret=interpret,
+                             max_len=max_len)
+
+    def warm(fn):
+        check_op(f"spmv_scan.{rung}",
+                 fn(jnp.zeros(n, dtype), jnp.zeros(n, dtype),
+                    jnp.zeros(n, jnp.int32),
+                    jnp.zeros(max(1, (p or 1) - 1), jnp.int32)))
+
+    return programs.get("spmv_scan", rung, f"n{n}/i{iters}", build,
+                        dtype=np.dtype(dtype).name, warm=warm, **static)
+
+
+def _bucket_gate(n_to: int, kernel: str, dtype) -> bool:
+    """One verdict per (bucket, kernel, dtype): prove pad-and-mask is
+    exact before serving from the bucket.  A probe problem inside the
+    bucket is solved padded-then-sliced and unpadded; the two must be
+    bitwise equal (``pad_problem``'s quarantined-tail contract — padded
+    values are 0·x[0] in their own segment, so real segments never see
+    them).  A failing probe keeps the caller on exact shapes —
+    correctness is never traded for compile amortization."""
+    from ..core import conformance
+
+    n_from = max(2, (3 * n_to) // 4)
+    if n_from >= n_to:
+        return False  # bucket too small to pad into
+    probe = generate_problem(n_from, p=max(3, min(9, n_from // 2)),
+                             q=7, iters=2, seed=99)
+
+    def solve(pr: Problem) -> np.ndarray:
+        fn = _program(kernel, pr.n, pr.iters, dtype, p=pr.p,
+                      max_len=int(np.diff(pr.s).max()))
+        return np.asarray(fn(
+            jnp.asarray(pr.a, dtype), jnp.asarray(pr.xx, dtype),
+            head_flags_from_starts(jnp.asarray(pr.s[:-1]), pr.n),
+            jnp.asarray(pr.s[:-1])))
+
+    return conformance.check(
+        "spmv_scan.pad", kernel,
+        shape_class=f"n{n_to}/{np.dtype(dtype).name}",
+        candidate=lambda: solve(pad_problem(probe, n_to))[:probe.n],
+        reference=lambda: solve(probe), rel_l2=0.0).ok
 
 
 def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
                   dtype=jnp.float32, kernel: str = "auto",
-                  fallback: bool = True) -> np.ndarray:
+                  fallback: bool = True,
+                  canonical: bool = False) -> np.ndarray:
     """Device pipeline (fp.cu:154-190): upload, N × (multiply + segmented
     scan), download — the N iterations run as ONE jitted ``fori_loop``
     with the value buffer donated, whatever the kernel.  Prints the
@@ -434,12 +535,31 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
     fault-injection guard and the ladder bookkeeping run in host Python
     before the jitted loop launches, so the healthy path times
     identically.
+
+    With ``canonical``, the request shape is snapped to its power-of-two
+    bucket first (``core/programs.canonical_size``): the problem is
+    zero-padded with a quarantined tail segment (``pad_problem``) and the
+    output sliced back, so heterogeneous sizes share one compiled program
+    per bucket.  Each (bucket, kernel, dtype) is conformance-probed once
+    — padded-then-sliced must match the unpadded solve bitwise — and a
+    failing probe silently falls back to the exact shape.
     """
-    from ..core import check_op, roofline, span, with_fallback
+    from ..core import roofline, span, with_fallback
 
     prob.validate()
+    if canonical:
+        from ..core import programs
+
+        n_to = programs.canonical_size(prob.n)
+        if n_to != prob.n and _bucket_gate(n_to, kernel, dtype):
+            out = run_spmv_scan(pad_problem(prob, n_to), timer=timer,
+                                dtype=dtype, kernel=kernel,
+                                fallback=fallback)
+            return out[:prob.n]
     xx = jnp.asarray(prob.xx, dtype)
     flags = head_flags_from_starts(jnp.asarray(prob.s[:-1]), prob.n)
+    starts = jnp.asarray(prob.s[:-1])
+    max_len = int(np.diff(prob.s).max())
     timer = timer or PhaseTimer()
 
     shape_class = f"n{prob.n}/i{prob.iters}"
@@ -447,27 +567,25 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
 
     def attempt(rung: str):
         def thunk():
-            runner = _make_runner(prob, xx, flags, rung)
+            # the process-wide program cache replaces the old per-call
+            # closure + warmup: a miss builds and warms inside the
+            # spmv_scan.compile span (feeding the per-shape-class
+            # compile.ms histogram and the retrace detector, with
+            # failures surfacing attributed to the rung before the timed
+            # phase opens — the CUDA analog timed only kernel execution
+            # between cudaEvents); a hit is one dict lookup, so a second
+            # call on a known shape class performs zero retraces
+            runner = _program(rung, prob.n, prob.iters, dtype, p=prob.p,
+                              max_len=max_len)
             # every kernel donates its value buffer, so each attempt gets
             # a fresh host->device upload — a rung that dies mid-run must
             # not leave the next rung a donated (invalid) buffer
             a = jnp.asarray(prob.a, dtype)
-            # warmup compile outside the timed region (the CUDA analog
-            # timed only kernel execution between cudaEvents); the named
-            # barrier forces compile/runtime failures to surface HERE,
-            # attributed to the rung, before the timed phase opens —
-            # spans split compile from run time per rung (feeding the
-            # per-shape-class compile.ms/run.ms histograms and the
-            # retrace detector), so trace summaries separate the two the
-            # way the reference's warmup discipline did implicitly
-            with span("spmv_scan.compile", kernel=rung,
-                      shape_class=shape_class):
-                check_op(f"spmv_scan.{rung}", runner(jnp.zeros_like(a)))
             with span("spmv_scan.run", kernel=rung, n=prob.n,
                       iters=prob.iters, shape_class=shape_class) as sp:
                 sp.roofline(cost.nbytes, cost.flops)
                 with timer.phase("spmv_scan") as ph:
-                    out = runner(a)
+                    out = runner(a, xx, flags, starts)
                     ph.block(out)
             return out
         return thunk
@@ -526,9 +644,14 @@ def run_spmv_scan_checkpointed(prob: Problem, path: str, every: int = 0,
     if not decision.admitted:
         raise admission.AdmissionError(f"spmv_scan: {decision.detail}")
 
+    starts = jnp.asarray(prob.s[:-1])
+
     def step(state, k):
-        return _iterate(jnp.asarray(state, dtype), xx, flags, k,
-                        scan=kernel)
+        # per-chunk-size programs come from the process-wide cache: a
+        # resumed or retried solve re-running a chunk length it has seen
+        # is a dict lookup, not a recompile
+        fn = _program(kernel, prob.n, k, dtype, p=prob.p)
+        return fn(jnp.asarray(state, dtype), xx, flags, starts)
 
     out = run_with_checkpoints(step, a0, prob.iters,
                                path, every=every, guard=all_finite,
@@ -741,7 +864,7 @@ def main(argv: list[str]) -> int:
 
         spmv_scan a.txt x.txt [cpu_check]
                   [--kernel=auto|flat|blocked|pallas|pallas-fused|dense]
-                  [--distributed]
+                  [--distributed] [--canonical]
         spmv_scan gen a.txt x.txt [n p q [iters]] [--seed=S]
         spmv_scan mtx matrix.mtx [cpu_check] [--kernel=...] [--seed=S]
 
@@ -755,6 +878,7 @@ def main(argv: list[str]) -> int:
     kernel = "auto"
     seed = 0
     distributed = False
+    canonical = False
     for a in argv[1:]:
         if a.startswith("--kernel="):
             kernel = a.split("=", 1)[1]
@@ -762,6 +886,8 @@ def main(argv: list[str]) -> int:
             seed = int(a.split("=", 1)[1])
         elif a == "--distributed":
             distributed = True
+        elif a == "--canonical":
+            canonical = True
         elif a.startswith("--"):
             print(f"error: unknown option {a!r} (flags use --name=value)")
             return 2
@@ -832,7 +958,7 @@ def main(argv: list[str]) -> int:
         print(f"The running time of my code for {prob.iters} iterations "
               f"is: {ms} milliseconds. ({ndev} devices)")
     else:
-        out = run_spmv_scan(prob, kernel=kernel)
+        out = run_spmv_scan(prob, kernel=kernel, canonical=canonical)
 
     def write_out(path: str, values: np.ndarray) -> None:
         try:

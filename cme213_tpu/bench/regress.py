@@ -21,7 +21,10 @@ tooling:
   too — a kernel that stopped producing data is the worst kind of slow.
 - **metrics.json** — per-sweep row counts from ``bench/run_all.py``'s
   sidecar: a sweep that produced fewer rows than its baseline lost
-  coverage.
+  coverage.  The sidecar's ``compile.<op>.<class>.ms`` histograms are
+  compared too (mean, lower-better); a compile histogram that vanished
+  from the fresh run means the program cache served it warm and is
+  never flagged.
 - **Headline trajectory** — ``--bench`` (a ``bench.py`` JSON output or a
   capture file whose ``tail`` embeds one) compared against the best
   prior value across ``--history``'s ``BENCH_r*.json`` captures — the
@@ -159,6 +162,31 @@ def compare_dirs(fresh_dir: str, baseline_dir: str,
                 regs.append({"file": side, "row": sweep, "metric": "rows",
                              "baseline": brows, "fresh": frows,
                              "ratio": round((frows or 0) / brows, 4)})
+            # compile-time histograms (``compile.<op>.<class>.ms`` from
+            # the program cache's miss spans): mean is lower-better.  A
+            # compile histogram present in the baseline but absent from
+            # the fresh run means the program came from a warm cache —
+            # that's the win this sidecar exists to verify, never a
+            # regression — so only pairs present on both sides compare.
+            bhists = brec.get("metrics", {}).get("histograms", {})
+            fhists = fm.get(sweep, {}).get("metrics", {}) \
+                       .get("histograms", {})
+            for hname in sorted(set(bhists) & set(fhists)):
+                if not (hname.startswith("compile.")
+                        and hname.endswith(".ms")):
+                    continue
+                bmean = _fnum(bhists[hname].get("mean"))
+                fmean = _fnum(fhists[hname].get("mean"))
+                if bmean is None or bmean <= 0 or fmean is None:
+                    continue
+                ratio = fmean / bmean
+                entry = {"file": side, "row": sweep, "metric": hname,
+                         "baseline": bmean, "fresh": fmean,
+                         "ratio": round(ratio, 4)}
+                if ratio > 1 + threshold:
+                    regs.append(entry)
+                elif ratio < 1 - threshold:
+                    imps.append(entry)
     return {"files": files,
             "baseline_only": sorted(base_csvs - fresh_csvs),
             "fresh_only": sorted(fresh_csvs - base_csvs),

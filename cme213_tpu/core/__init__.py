@@ -6,7 +6,7 @@ from .resilience import (FailureKind, FallbackResult, NonFiniteError,
                          with_fallback)
 from .trace import (EVENT_SCHEMA, clear_events, events, flush_sink,
                     record_event, span, validate_record)
-from . import admission, conformance, metrics, roofline
+from . import admission, conformance, metrics, programs, roofline
 
 __all__ = [
     "PhaseTimer",
@@ -34,5 +34,6 @@ __all__ = [
     "admission",
     "conformance",
     "metrics",
+    "programs",
     "roofline",
 ]

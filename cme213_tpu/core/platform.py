@@ -50,21 +50,30 @@ def enable_compile_cache(path: str | None = None) -> None:
     window consumed by one cold compile).  With the cache, a kernel
     compiled in any earlier window or child loads back in milliseconds.
 
-    Enabled for TPU runs only: explicit-CPU runs (tests, fake-mesh
-    rehearsals) are compile-cheap and would just churn the cache dir.
+    By default the cache is TPU-only: implicit-CPU runs (tests, fake-mesh
+    rehearsals) are compile-cheap and would just churn the default cache
+    dir.  An **explicit** opt-in — ``path`` or the ``CME213_COMPILE_CACHE``
+    env var — enables it on any platform, which is the warm-start path:
+    ``python -m cme213_tpu serve warmup`` pre-compiles the canonical
+    serving buckets into the dir, and a later process start loads every
+    known shape class from disk instead of compiling it fresh.  On CPU
+    the min-compile-time floor drops to 0 so the sub-second CPU compiles
+    actually persist.
     """
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    explicit = path or os.environ.get("CME213_COMPILE_CACHE")
+    on_cpu = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    if on_cpu and not explicit:
         return
     import jax
 
-    cache_dir = path or os.environ.get(
-        "CME213_COMPILE_CACHE",
-        os.path.join(os.path.dirname(os.path.dirname(
+    cache_dir = explicit or os.path.join(
+        os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))),
-            ".jax_compile_cache"))
+        ".jax_compile_cache")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0 if on_cpu else 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception as e:  # older jax without these flags — cache optional,
         # but a silent miss re-opens the cold-compile-per-window cost, so say so
@@ -127,8 +136,11 @@ def force_cpu_devices(n_devices: int) -> None:
     jax.config.update("jax_platforms", "cpu")
     try:
         # CPU test/rehearsal compiles are cheap; don't churn the TPU
-        # compile cache (enabled at package import) with their entries
-        jax.config.update("jax_compilation_cache_dir", None)
+        # compile cache (enabled at package import) with their entries —
+        # unless the operator explicitly asked for a cache dir (the
+        # warm-start opt-in), which wins
+        if not os.environ.get("CME213_COMPILE_CACHE"):
+            jax.config.update("jax_compilation_cache_dir", None)
     except Exception as e:
         import sys
 

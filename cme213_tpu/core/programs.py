@@ -1,0 +1,118 @@
+"""Process-wide program cache — compile once per shape class, serve hits.
+
+The reference's CUDA workloads load their module once and serve every
+launch from it; our dispatch historically rebuilt fresh jit closures per
+call, so every solve re-entered the trace/compile path — exactly what
+the retrace detector (``core/trace._note_compile_run``, ROADMAP item 5's
+measurement half) counts.  This module is the amortization half: a
+process-wide cache of **warmed** callables keyed by
+
+    (op, rung, shape_class, dtype, static params)
+
+Dispatch (``apps/spmv_scan.py``, ``ops/stencil_pipeline.py``,
+``apps/heat2d.py``), the serving batch runners (``serve/workloads.py``),
+and the conformance-gate probes all fetch their programs through
+:func:`get`:
+
+- **hit**: one dict lookup returns the already-warmed callable — no
+  compile span opens, no warmup runs, the retrace detector sees nothing
+  (``program-cache-hit`` event, ``programs.hits`` counter);
+- **miss**: ``build()`` runs inside an ``<op>.compile`` span (feeding the
+  ``compile.<op>.<class>.ms`` histogram and the retrace detector), then
+  ``warm(fn)`` executes the program once behind the caller's named
+  barrier before the entry is published (``program-cache-miss`` event,
+  ``programs.misses`` counter).  A build or warmup that raises caches
+  nothing — a rung that failed to compile is a demotion, not a program.
+
+Cached callables must take every per-problem array as an **argument**
+(values, gathered x, head flags, grids) — closing over request data
+would serve one caller's inputs to another.  Anything that changes the
+compiled program (iteration count, tile size, CFL constants, batch
+width) goes into the key via ``**static``.
+
+:func:`canonical_size` is the pad-and-mask companion: it snaps request
+sizes to power-of-two buckets so heterogeneous traffic lands on a small
+set of shape classes (the T5X canonical-shapes discipline), which is
+what makes a per-class cache finite under real load.
+
+``reset()`` clears the cache (tests; also invoked by
+``trace.clear_events`` so compile counts and cached programs move
+together).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import metrics
+from .trace import record_event, span
+
+_LOCK = threading.RLock()
+_CACHE: dict[tuple, object] = {}
+
+
+def canonical_size(n: int, floor: int = 1) -> int:
+    """The canonical shape bucket for a size-``n`` request: the next
+    power of two (>= ``floor``).  Generalizes the coarse buckets that
+    used to exist only in degraded serving mode — padding requests up to
+    a bucket (``apps.spmv_scan.pad_problem``'s quarantined tail) trades
+    O(n) zero-padded work for a bounded set of compiled programs."""
+    n = max(int(n), int(floor))
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+def _key(op: str, rung: str, shape_class: str, dtype, static: dict) -> tuple:
+    return (op, str(rung), str(shape_class), str(dtype),
+            tuple(sorted((k, repr(v)) for k, v in static.items())))
+
+
+def get(op: str, rung: str, shape_class: str, build, *, dtype="f32",
+        warm=None, **static):
+    """The process-wide program for ``(op, rung, shape_class, dtype,
+    static)`` — built, warmed, and cached on first use; a dict lookup
+    ever after.
+
+    ``build()`` returns the callable; ``warm(fn)`` (optional) executes it
+    once so XLA compiles outside any timed region — both run inside the
+    ``<op>.compile`` span on a miss, so the compile/run split and the
+    retrace detector keep measuring exactly what they did before, and a
+    second call on a known shape class measurably does *nothing*.
+    """
+    key = _key(op, rung, shape_class, dtype, static)
+    with _LOCK:
+        fn = _CACHE.get(key)
+    if fn is not None:
+        record_event("program-cache-hit", op=op, rung=rung,
+                     shape_class=shape_class)
+        metrics.counter("programs.hits").inc()
+        return fn
+    record_event("program-cache-miss", op=op, rung=rung,
+                 shape_class=shape_class)
+    metrics.counter("programs.misses").inc()
+    with span(f"{op}.compile", kernel=rung, shape_class=shape_class):
+        fn = build()
+        if warm is not None:
+            warm(fn)
+    with _LOCK:
+        _CACHE[key] = fn
+    return fn
+
+
+def size() -> int:
+    """Number of cached programs."""
+    with _LOCK:
+        return len(_CACHE)
+
+
+def keys() -> list[tuple]:
+    """Snapshot of cache keys (introspection/tests)."""
+    with _LOCK:
+        return sorted(_CACHE)
+
+
+def reset() -> None:
+    """Forget every cached program (tests; paired with
+    ``trace.clear_events`` so a fresh telemetry slate implies a cold
+    cache)."""
+    with _LOCK:
+        _CACHE.clear()

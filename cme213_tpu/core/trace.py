@@ -96,7 +96,10 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "kernel-failure": ("op", "kernel", "error"),
     "device-memory": ("path", "bytes"),
     # compile/run split (this module; ROADMAP item 5's measurement half)
-    "compile-retrace": ("op", "shape_class", "count"),
+    "compile-retrace": ("op", "shape_class", "kernel", "count"),
+    # program cache (core/programs.py; ROADMAP item 5's amortization half)
+    "program-cache-hit": ("op", "rung", "shape_class"),
+    "program-cache-miss": ("op", "rung", "shape_class"),
     # distributed commits (dist/ckpt.py)
     "epoch-commit": ("epoch", "step", "world", "shards", "ms"),
     "commit-invalid": ("candidate", "error", "message"),
@@ -260,12 +263,18 @@ def events(event: str | None = None) -> list[dict]:
 
 def clear_events() -> None:
     """Drop recorded events (and the retrace detector's compile counts)
-    and re-read the ring-buffer cap env."""
+    and re-read the ring-buffer cap env.  The program cache
+    (``core/programs.py``) resets with the compile counts: the two move
+    together so "first call compiles, later calls hit" stays an invariant
+    a fresh telemetry slate can rely on."""
     global _EVENTS, _BUFFER_CONFIGURED
     with _LOCK:
         _EVENTS = deque()
         _BUFFER_CONFIGURED = False
         _COMPILE_COUNTS.clear()
+    from . import programs
+
+    programs.reset()
 
 
 # ------------------------------------------------------------------ spans
@@ -361,32 +370,38 @@ def span(name: str, **tags):
 
         metrics.histogram(f"span.{name}.ms").observe(ms)
         if err is None:
-            _note_compile_run(name, tags.get("shape_class"), ms)
+            _note_compile_run(name, tags.get("shape_class"), ms,
+                              tags.get("kernel"))
 
 
 # --------------------------------------------------- compile/run split
 
-#: (op, shape_class) -> completed ``<op>.compile`` span count — the
-#: retrace detector's state (ROADMAP item 5: heterogeneous traffic must
-#: not re-trace known shape classes).  Reset by ``clear_events``.
+#: (op, shape_class, kernel) -> completed ``<op>.compile`` span count —
+#: the retrace detector's state (ROADMAP item 5: heterogeneous traffic
+#: must not re-trace known shape classes).  The kernel rung is part of
+#: the key: a fallback ladder (or conformance gate) compiling a SECOND
+#: rung for a class it already serves builds a fresh program, not a
+#: retrace.  Reset by ``clear_events``.
 _COMPILE_COUNTS: dict[tuple, int] = {}
 
 
 def compile_counts() -> dict[tuple, int]:
-    """Snapshot of per-(op, shape_class) compile counts this process."""
+    """Snapshot of per-(op, shape_class, kernel) compile counts this
+    process (``kernel`` is ``None`` for spans without a kernel tag)."""
     with _LOCK:
         return dict(_COMPILE_COUNTS)
 
 
-def _note_compile_run(name: str, shape_class, ms: float) -> None:
+def _note_compile_run(name: str, shape_class, ms: float,
+                      kernel=None) -> None:
     """Feed per-(op, shape-class) ``compile.ms``/``run.ms`` histograms
     from ``<op>.compile``/``<op>.run`` spans, and fire the retrace
-    detector: a shape class whose compile span completes more than once
-    in a process re-entered the trace/compile path — the retracing cost
-    ROADMAP item 5's compile-cache layer will have to kill — so it emits
-    a ``compile-retrace`` event and bumps the ``compile.retraces``
-    counter.  Errored spans are excluded upstream (a rung that failed to
-    compile is a demotion, not a retrace)."""
+    detector: a (shape class, kernel) whose compile span completes more
+    than once in a process re-entered the trace/compile path — the
+    retracing cost the program cache (``core/programs.py``) exists to
+    kill — so it emits a ``compile-retrace`` event and bumps the
+    ``compile.retraces`` counter.  Errored spans are excluded upstream
+    (a rung that failed to compile is a demotion, not a retrace)."""
     if shape_class is None:
         return
     from . import metrics
@@ -395,12 +410,12 @@ def _note_compile_run(name: str, shape_class, ms: float) -> None:
         op = name[: -len(".compile")]
         metrics.histogram(f"compile.{op}.{shape_class}.ms").observe(ms)
         with _LOCK:
-            n = _COMPILE_COUNTS[(op, shape_class)] = (
-                _COMPILE_COUNTS.get((op, shape_class), 0) + 1)
+            n = _COMPILE_COUNTS[(op, shape_class, kernel)] = (
+                _COMPILE_COUNTS.get((op, shape_class, kernel), 0) + 1)
         if n > 1:
             metrics.counter("compile.retraces").inc()
             record_event("compile-retrace", op=op,
-                         shape_class=shape_class, count=n)
+                         shape_class=shape_class, kernel=kernel, count=n)
     elif name.endswith(".run"):
         op = name[: -len(".run")]
         metrics.histogram(f"run.{op}.{shape_class}.ms").observe(ms)

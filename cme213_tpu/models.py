@@ -109,7 +109,8 @@ WORKLOADS: dict[str, Workload] = {
         # shape-class batching, deadlines, breaker, degradation)
         Workload("serve", "serving", "loadgen: drive the bounded-queue "
                  "batching front end with synthetic load, print an SLO "
-                 "report", _serve),
+                 "report; warmup: pre-compile the canonical serving "
+                 "buckets for warm starts", _serve),
     )
 }
 

@@ -29,15 +29,23 @@ def main(argv: list[str]) -> int:
     import sys
 
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m cme213_tpu serve loadgen [args...]\n\n"
+        print("usage: python -m cme213_tpu serve <loadgen|warmup> "
+              "[args...]\n\n"
               "subcommands:\n"
               "  loadgen   drive the server with synthetic load and print "
-              "an SLO report")
+              "an SLO report\n"
+              "  warmup    pre-compile the canonical serving buckets "
+              "(with CME213_COMPILE_CACHE set, into the persistent disk "
+              "cache for warm process starts)")
         return 0 if argv else 2
     if argv[0] == "loadgen":
         from . import loadgen
 
         return loadgen.main(argv[1:])
-    print(f"serve: unknown subcommand {argv[0]!r} (try loadgen)",
+    if argv[0] == "warmup":
+        from . import warmup
+
+        return warmup.main(argv[1:])
+    print(f"serve: unknown subcommand {argv[0]!r} (try loadgen | warmup)",
           file=sys.stderr)
     return 2

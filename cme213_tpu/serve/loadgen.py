@@ -120,6 +120,49 @@ def run_load(server: Server, specs: list[RequestSpec],
     return {"results": results, "elapsed_s": clock.now() - t0}
 
 
+def compile_attribution(before: dict, after: dict) -> dict:
+    """Per-shape-class compile-vs-run attribution from the metrics delta:
+    how much of the pass went to (re)tracing (``compile.<op>.<class>.ms``)
+    vs executing (``run.<op>.<class>.ms``), plus the retrace count and the
+    program cache's hit/miss counts.  ``compile_share`` near zero is the
+    warmed steady state the program cache exists to reach."""
+    bh, ah = before.get("histograms", {}), after.get("histograms", {})
+    bc, ac = before.get("counters", {}), after.get("counters", {})
+
+    def counter_delta(name: str) -> int:
+        return int(ac.get(name, 0)) - int(bc.get(name, 0))
+
+    per_class: dict[str, dict] = {}
+    totals = {"compile": 0.0, "run": 0.0}
+    for name, h in ah.items():
+        for kind, ms_key, n_key in (("compile", "compile_ms", "compiles"),
+                                    ("run", "run_ms", "runs")):
+            if not (name.startswith(kind + ".") and name.endswith(".ms")):
+                continue
+            key = name[len(kind) + 1:-3]
+            prev = bh.get(name) or {}
+            d_ms = float(h.get("sum") or 0.0) - float(prev.get("sum") or 0.0)
+            d_n = int(h.get("count", 0)) - int(prev.get("count", 0))
+            if d_n <= 0:
+                continue
+            row = per_class.setdefault(
+                key, {"compile_ms": 0.0, "compiles": 0,
+                      "run_ms": 0.0, "runs": 0})
+            row[ms_key] = round(row[ms_key] + d_ms, 3)
+            row[n_key] += d_n
+            totals[kind] += d_ms
+    total = totals["compile"] + totals["run"]
+    return {
+        "per_class": per_class,
+        "compile_ms": round(totals["compile"], 3),
+        "run_ms": round(totals["run"], 3),
+        "compile_share": round(totals["compile"] / total, 4) if total else 0.0,
+        "retraces": counter_delta("compile.retraces"),
+        "cache_hits": counter_delta("programs.hits"),
+        "cache_misses": counter_delta("programs.misses"),
+    }
+
+
 def slo_report(run: dict, before: dict, after: dict) -> dict:
     """The SLO view of a :func:`run_load` run: latency percentiles over
     served requests, throughput, shed accounting, breaker transitions —
@@ -166,6 +209,7 @@ def slo_report(run: dict, before: dict, after: dict) -> dict:
             "skipped": counters.get("breaker.skipped", 0),
         },
         "demotions": counters.get("fallback.demotions", 0),
+        "compile": compile_attribution(before, after),
     }
 
 
@@ -194,6 +238,18 @@ def format_report(report: dict) -> str:
         lines.append(f"breaker: {br['opened']} opened, {br['half_open']} "
                      f"half-open probes, {br['closed']} closed, "
                      f"{br['skipped']} requests routed around")
+    comp = report.get("compile")
+    if comp:
+        lines.append(
+            f"compile: {comp['compile_ms']} ms vs run {comp['run_ms']} ms "
+            f"(share {comp['compile_share']:.1%}), "
+            f"{comp['retraces']} retrace(s), program cache "
+            f"{comp['cache_hits']} hit / {comp['cache_misses']} miss")
+        for key in sorted(comp["per_class"]):
+            row = comp["per_class"][key]
+            lines.append(
+                f"  {key}: compile {row['compile_ms']} ms "
+                f"x{row['compiles']}, run {row['run_ms']} ms x{row['runs']}")
     if "baseline" in report:
         b = report["baseline"]
         lines.append(f"baseline (max_batch=1): {b['throughput_rps']} req/s "
@@ -226,6 +282,15 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--baseline", action="store_true",
                     help="also replay through max_batch=1 and report the "
                     "batched/serial throughput ratio")
+    ap.add_argument("--warm", action="store_true",
+                    help="run one untimed pass first so the measured pass "
+                    "reflects the warmed steady state (every program a "
+                    "cache hit; compile share ~ 0)")
+    ap.add_argument("--max-retraces", type=int, default=None,
+                    help="exit nonzero when the pass records more than this "
+                    "many compile retraces (the steady-state gate: with the "
+                    "program cache every shape class compiles at most once, "
+                    "so 0 is the expected value)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
@@ -258,6 +323,11 @@ def main(argv: list[str]) -> int:
                         round(len(b_served) / b_run["elapsed_s"], 2)
                         if b_run["elapsed_s"] > 0 else None}
 
+    if args.warm:
+        # same seed + closed-loop discipline → the warm pass forms the
+        # same batches, so the measured pass serves every shape class
+        # (and batch width) from the program cache
+        run_pass(args.max_batch)
     before = metrics.snapshot()
     run = run_pass(args.max_batch)
     report = slo_report(run, before, metrics.snapshot())
@@ -272,6 +342,11 @@ def main(argv: list[str]) -> int:
         print(json.dumps(report, indent=2))
     else:
         print(format_report(report))
+    retraces = report["compile"]["retraces"]
+    if args.max_retraces is not None and retraces > args.max_retraces:
+        print(f"FAIL: {retraces} compile retrace(s) exceed "
+              f"--max-retraces={args.max_retraces}", file=sys.stderr)
+        return 1
     return 0
 
 

@@ -1,0 +1,115 @@
+"""Pre-compile the canonical serving buckets — the warm-start half of the
+compile-amortization story.
+
+``python -m cme213_tpu serve warmup`` derives the shape classes a serving
+mix will hit (the same population ``loadgen`` drives), then runs each
+(op, shape class, batch width, rung) combination once through the
+adapters' batch paths.  Every program lands in the process-wide cache
+(``core/programs.py``) **and** — when ``CME213_COMPILE_CACHE`` points at
+a directory (``core/platform.enable_compile_cache``) — in the persistent
+XLA disk cache, so a later server process starts with every known shape
+class loading from disk instead of compiling fresh: zero fresh compiles
+on the request path from the first batch.
+
+The report is the same compile-attribution section the loadgen SLO
+report carries: per-class compile ms, program-cache misses (one per
+warmed program), and where the disk cache landed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..core import metrics
+
+
+def warm_buckets(mix: str, requests: int = 12, max_batch: int = 8,
+                 seed: int = 0) -> list[str]:
+    """Run one batch per (op, shape class, batch width, rung) of the
+    mix's canonical buckets through the adapters — compiling each program
+    into the process cache and (if enabled) the persistent disk cache.
+    Batch widths 1 and ``max_batch`` are warmed: the widths a drained
+    tail and a full batch window actually dispatch.  Returns the warmed
+    ``op[class]/bN`` labels."""
+    from .loadgen import build_mix
+    from .workloads import ADAPTERS
+
+    specs = build_mix(mix, requests, seed=seed)
+    groups: dict[tuple[str, str], list] = {}
+    for spec in specs:
+        adapter = ADAPTERS[spec.op]
+        key = (spec.op, adapter.shape_class(spec.payload))
+        groups.setdefault(key, []).append(spec.payload)
+
+    warmed = []
+    for (op, sc), payloads in sorted(groups.items()):
+        adapter = ADAPTERS[op]
+        for b in sorted({1, max(1, max_batch)}):
+            batch = (payloads * b)[:b]
+            ok = True
+            for rung in adapter.rungs():
+                try:
+                    adapter.run_batch(batch, rung)
+                except Exception as e:  # noqa: BLE001 — warmup is advisory
+                    ok = False
+                    print(f"warmup: {op}[{sc}] rung {rung!r} failed: {e}",
+                          file=sys.stderr)
+            if ok:
+                warmed.append(f"{op}[{sc}]/b{b}")
+    return warmed
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="serve warmup",
+        description="pre-compile the canonical serving buckets into the "
+                    "program cache and (with CME213_COMPILE_CACHE set) the "
+                    "persistent XLA disk cache")
+    ap.add_argument("--mix", default="spmv,heat,cipher",
+                    help="comma-separated ops, as for loadgen --mix")
+    ap.add_argument("--requests", type=int, default=12,
+                    help="mix length used to derive the bucket set")
+    ap.add_argument("--max-batch", type=int, default=8,
+                    help="full batch width to warm (width 1 always is)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    from ..core import programs
+    from .loadgen import compile_attribution
+
+    cache_dir = os.environ.get("CME213_COMPILE_CACHE")
+    before = metrics.snapshot()
+    warmed = warm_buckets(args.mix, requests=args.requests,
+                          max_batch=args.max_batch, seed=args.seed)
+    report = {
+        "warmed": warmed,
+        "programs": programs.size(),
+        "persistent_cache": cache_dir,
+        "persistent_entries": (len(os.listdir(cache_dir))
+                               if cache_dir and os.path.isdir(cache_dir)
+                               else None),
+        "compile": compile_attribution(before, metrics.snapshot()),
+    }
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        comp = report["compile"]
+        print(f"warmed {len(warmed)} bucket(s), {report['programs']} "
+              f"cached program(s), compile {comp['compile_ms']} ms")
+        for label in warmed:
+            print(f"  {label}")
+        if cache_dir:
+            print(f"persistent cache {cache_dir}: "
+                  f"{report['persistent_entries']} entr(ies)")
+        else:
+            print("persistent cache: disabled "
+                  "(set CME213_COMPILE_CACHE=<dir> for warm process starts)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

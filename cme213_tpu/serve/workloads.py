@@ -7,14 +7,18 @@ needs:
 
 - **shape-class keying** (``shape_class``): requests whose jitted
   program would be identical share a bucket, using the same keys the
-  conformance cache uses (``core/conformance.py``) — spmv by
-  ``n/iters``, heat by padded grid shape/order/iters, cipher by byte
-  length.  ``coarse=True`` is the degraded-mode keying: spmv rounds
-  ``n`` up to the next power of two (requests are zero-padded with a
-  quarantined tail segment — ``apps.spmv_scan.pad_problem`` — so
-  near-sized classes merge into one program and the compile-cache stops
-  fragmenting under pressure); heat and cipher classes are exact by
-  construction (padding a grid would move its physical boundary).
+  conformance cache uses (``core/conformance.py``) — spmv by canonical
+  ``n`` bucket/iters, heat by grid shape/order/iters, cipher by byte
+  length.  Spmv sizes are **always** snapped to their power-of-two
+  bucket (``core/programs.canonical_size`` — requests are zero-padded
+  with a quarantined tail segment, ``apps.spmv_scan.pad_problem``, and
+  outputs sliced back), generalizing what used to be degraded-mode-only
+  coarsening: near-sized classes share one cached program and the
+  program cache stays finite under heterogeneous load.  Each bucket is
+  conformance-probed once (``apps.spmv_scan._bucket_gate``) before it
+  serves — padded-then-sliced must match the unpadded solve bitwise.
+  Heat and cipher classes are exact by construction (padding a grid
+  would move its physical boundary).
 - **batched execution** (``run_batch``): all payloads of one bucket run
   as ONE device program via the apps' vmap/stacking entry points, each
   lane bitwise-equal to its serial solve.
@@ -35,7 +39,9 @@ import numpy as np
 
 
 def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1)).bit_length()
+    from ..core.programs import canonical_size
+
+    return canonical_size(n)
 
 
 @dataclass
@@ -54,8 +60,11 @@ class SpmvAdapter:
     op = "spmv_scan"
 
     def shape_class(self, prob, coarse: bool = False) -> str:
-        n = _next_pow2(prob.n) if coarse else prob.n
-        return f"n{n}/i{prob.iters}"
+        # always the canonical power-of-two bucket: near-sized requests
+        # share one cached program whatever the serving mode (coarse
+        # keying used to be the degraded-mode exception; now it is the
+        # rule, and degraded mode differs only in its rung ladder)
+        return f"n{_next_pow2(prob.n)}/i{prob.iters}"
 
     def rungs(self, degraded: bool = False) -> tuple[str, ...]:
         # blocked is the O(n) throughput rung; flat is the bitwise-stable
@@ -64,14 +73,26 @@ class SpmvAdapter:
         return ("flat",) if degraded else ("blocked", "flat")
 
     def run_batch(self, probs, rung: str, coarse: bool = False):
-        from ..apps.spmv_scan import pad_problem, run_spmv_scan_batched
+        import jax.numpy as jnp
 
-        if coarse:
-            n_to = _next_pow2(max(p.n for p in probs))
-            padded = [pad_problem(p, n_to) for p in probs]
-            outs = run_spmv_scan_batched(padded, kernel=rung)
-            return [o[:p.n] for p, o in zip(probs, outs)]
-        return run_spmv_scan_batched(list(probs), kernel=rung)
+        from ..apps.spmv_scan import (_bucket_gate, pad_problem,
+                                      run_spmv_scan_batched)
+
+        ns = [p.n for p in probs]
+        n_to = _next_pow2(max(ns))
+        if any(n != n_to for n in ns):
+            # one probe per (bucket, rung): padded-then-sliced must be
+            # bitwise-equal to the unpadded solve before the bucket
+            # serves.  A failing probe raises so the ladder demotes to a
+            # rung whose padding IS exact instead of serving silently
+            # wrong prefixes.
+            if not _bucket_gate(n_to, rung, jnp.float32):
+                raise RuntimeError(
+                    f"pad-and-mask probe failed for bucket n{n_to} on "
+                    f"rung {rung!r}")
+            probs = [pad_problem(p, n_to) for p in probs]
+        outs = run_spmv_scan_batched(list(probs), kernel=rung)
+        return [o[:n] for n, o in zip(ns, outs)]
 
     def preflight_builder(self, probs, rung: str, coarse: bool = False):
         from ..core import admission
@@ -79,8 +100,7 @@ class SpmvAdapter:
 
         import jax.numpy as jnp
 
-        p0 = probs[0] if not coarse else pad_problem(
-            probs[0], _next_pow2(max(p.n for p in probs)))
+        p0 = pad_problem(probs[0], _next_pow2(max(p.n for p in probs)))
         n, iters = p0.n, p0.iters
 
         def preflight_at(size: int) -> admission.Decision:
@@ -159,20 +179,36 @@ class CipherAdapter:
     def run_batch(self, reqs, rung: str, coarse: bool = False):
         import jax.numpy as jnp
 
+        from ..core import check_op, programs, span
         from ..ops.elementwise import (
             shift_cipher_batched,
             shift_cipher_packed_batched,
         )
 
+        if rung == "packed":
+            kernel_fn = shift_cipher_packed_batched
+        elif rung == "bytes":
+            kernel_fn = shift_cipher_batched
+        else:
+            raise ValueError(f"unknown cipher rung {rung!r}")
+        b, n = len(reqs), int(reqs[0].text.shape[0])
+        shape_class = f"n{n}/u8/b{b}"
+
+        def warm(fn):
+            check_op(f"cipher_batched.{rung}",
+                     fn(jnp.zeros((b, n), jnp.uint8),
+                        jnp.zeros((b,), jnp.int32)))
+
+        runner = programs.get("cipher_batched", rung, shape_class,
+                              lambda: kernel_fn, dtype="u8", warm=warm,
+                              batch=b)
         data = jnp.asarray(np.stack([r.text for r in reqs]))
         shifts = jnp.asarray(np.array([r.shift for r in reqs],
                                       dtype=np.int32))
-        if rung == "packed":
-            out = shift_cipher_packed_batched(data, shifts)
-        elif rung == "bytes":
-            out = shift_cipher_batched(data, shifts)
-        else:
-            raise ValueError(f"unknown cipher rung {rung!r}")
+        with span("cipher_batched.run", kernel=rung,
+                  shape_class=shape_class) as sp:
+            out = runner(data, shifts)
+            sp.block(out)
         out = np.asarray(out)
         return [out[i] for i in range(len(reqs))]
 

@@ -145,8 +145,17 @@ def summarize(events: list[dict], out=None) -> dict:
     # (ROADMAP item 5's measurement half): spans named <op>.compile /
     # <op>.run carrying a shape_class tag
     split = defaultdict(lambda: {"compiles": 0, "compile_ms": 0.0,
-                                 "runs": 0, "run_ms": 0.0})
+                                 "runs": 0, "run_ms": 0.0,
+                                 "cache_hits": 0, "cache_misses": 0})
     for e in events:
+        if e["event"] in ("program-cache-hit", "program-cache-miss"):
+            # the program cache (core/programs.py): a hit is a dispatch
+            # that skipped compile entirely, a miss is the one build+warm
+            # that produced the row's compile span
+            d = split[(e.get("op"), e.get("shape_class"))]
+            d["cache_hits" if e["event"] == "program-cache-hit"
+              else "cache_misses"] += 1
+            continue
         if e["event"] != "span-end" or "shape_class" not in e:
             continue
         nm, ms = e.get("span", ""), e.get("ms")
@@ -165,10 +174,12 @@ def summarize(events: list[dict], out=None) -> dict:
     if split:
         w("compile vs run (ms):\n")
         w(f"  {'op [shape class]':<38} {'compiles':>8} {'ms':>9} "
-          f"{'runs':>5} {'ms':>9}\n")
+          f"{'runs':>5} {'ms':>9} {'hit/miss':>9}\n")
         for (op, sc), d in sorted(split.items()):
+            hit_miss = f"{d['cache_hits']}/{d['cache_misses']}"
             w(f"  {f'{op} [{sc}]':<38} {d['compiles']:>8} "
-              f"{d['compile_ms']:>9.2f} {d['runs']:>5} {d['run_ms']:>9.2f}\n")
+              f"{d['compile_ms']:>9.2f} {d['runs']:>5} {d['run_ms']:>9.2f} "
+              f"{hit_miss:>9}\n")
     if retraces:
         w(f"compile retraces: {sum(retraces.values())} ("
           + ", ".join(f"{op} [{sc}] x{n}"

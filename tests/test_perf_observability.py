@@ -142,7 +142,12 @@ def test_compile_run_histograms_and_retrace_detector():
     with span("op.compile", shape_class="b"):
         pass
     assert trace.events("compile-retrace") == []
-    # the known class compiling again IS one
+    # a different kernel rung in the known class is a fresh program too
+    # (a fallback ladder compiling its second rung is not a retrace)
+    with span("op.compile", kernel="other", shape_class="a"):
+        pass
+    assert trace.events("compile-retrace") == []
+    # the known (class, kernel) compiling again IS one
     with span("op.compile", shape_class="a"):
         pass
     ev = trace.events("compile-retrace")
@@ -151,10 +156,11 @@ def test_compile_run_histograms_and_retrace_detector():
     assert ev[0]["count"] == 2
     snap = metrics.snapshot()
     assert snap["counters"]["compile.retraces"] == 1
-    assert snap["histograms"]["compile.op.a.ms"]["count"] == 2
+    assert snap["histograms"]["compile.op.a.ms"]["count"] == 3
     assert snap["histograms"]["compile.op.b.ms"]["count"] == 1
     assert snap["histograms"]["run.op.a.ms"]["count"] == 1
-    assert trace.compile_counts()[("op", "a")] == 2
+    assert trace.compile_counts()[("op", "a", None)] == 2
+    assert trace.compile_counts()[("op", "a", "other")] == 1
 
 
 def test_errored_compile_span_is_not_a_retrace():
@@ -163,25 +169,35 @@ def test_errored_compile_span_is_not_a_retrace():
             with span("op.compile", shape_class="x"):
                 raise ValueError("no lowering")
     assert trace.events("compile-retrace") == []
-    assert ("op", "x") not in trace.compile_counts()
+    assert ("op", "x", None) not in trace.compile_counts()
 
 
 def test_forced_recompile_fires_through_real_dispatch(tmp_path, monkeypatch,
                                                       capsys):
-    """Acceptance: a forced recompile of a known shape class produces a
-    compile-retrace event visible in trace summary."""
+    """Acceptance, both halves of ROADMAP item 5: the program cache kills
+    the same-class retrace (second dispatch = cache hit, zero compile
+    spans), and a genuinely forgotten program (cache reset mid-process)
+    still fires the detector, visible in trace summary."""
     from cme213_tpu.apps import spmv_scan as sp
+    from cme213_tpu.core import programs
 
     path = tmp_path / "t.jsonl"
     monkeypatch.setenv(trace.TRACE_FILE_ENV, str(path))
     prob = sp.generate_problem(256, 5, 4, iters=2, seed=1)
     sp.run_spmv_scan(prob, kernel="flat")
-    # dispatch builds a fresh jit closure per call: same shape class,
-    # second warmup -> the retrace the compile-cache item must kill
+    # second call on the known shape class: a program-cache hit — no
+    # compile span, no retrace (this used to rebuild the jit closure and
+    # fire the detector; the cache is the fix the detector demanded)
     sp.run_spmv_scan(prob, kernel="flat")
+    assert trace.events("compile-retrace") == []
+    assert trace.events("program-cache-hit")
+    # forget the program but NOT the detector's compile counts: the next
+    # dispatch recompiles a class the process has seen -> a true retrace
+    programs.reset()
+    sp.run_spmv_scan(prob, kernel="flat")
+    assert trace.events("compile-retrace")
     trace.flush_sink()
     monkeypatch.delenv(trace.TRACE_FILE_ENV)
-    assert trace.events("compile-retrace")
     capsys.readouterr()
     assert trace_cli.main(["summary", str(path),
                            "--require", "compile-retrace"]) == 0
@@ -514,5 +530,5 @@ def test_event_schema_covers_new_events():
     for name, fields in (("kernel-failure", ("op", "kernel", "error")),
                          ("device-memory", ("path", "bytes")),
                          ("compile-retrace", ("op", "shape_class",
-                                              "count"))):
+                                              "kernel", "count"))):
         assert trace.EVENT_SCHEMA[name] == fields

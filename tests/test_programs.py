@@ -1,0 +1,279 @@
+"""The program cache (core/programs.py) and its two companions: pad-and-
+mask shape canonicalization (spmv requests snapped to power-of-two
+buckets, outputs sliced back bitwise-equal) and persistent-cache warm
+starts (a second process compiles nothing fresh for known shapes).
+
+The contract under test is the CUDA reference's load-module-once
+discipline: one compile per (op, rung, shape class, dtype, statics) per
+process, a dict lookup ever after — measured, not assumed, via the
+retrace detector and the program-cache hit/miss telemetry.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cme213_tpu.core import metrics, programs, trace
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    trace.flush_sink()
+    trace.clear_events()   # also resets the program cache
+    metrics.reset()
+    yield
+    trace.flush_sink()
+    trace.clear_events()
+    metrics.reset()
+
+
+# ------------------------------------------------------------ cache unit
+
+def test_canonical_size_buckets():
+    assert programs.canonical_size(1) == 1
+    assert programs.canonical_size(2) == 2
+    assert programs.canonical_size(3) == 4
+    assert programs.canonical_size(512) == 512
+    assert programs.canonical_size(513) == 1024
+    assert programs.canonical_size(1000) == 1024
+    assert programs.canonical_size(3, floor=16) == 16
+
+
+def test_miss_builds_and_warms_once_then_hits():
+    calls = {"build": 0, "warm": 0}
+
+    def build():
+        calls["build"] += 1
+        return lambda x: x + 1
+
+    def warm(fn):
+        calls["warm"] += 1
+        assert fn(1) == 2
+
+    fn1 = programs.get("probe", "r", "n8", build, dtype="f32", warm=warm,
+                       iters=2)
+    assert calls == {"build": 1, "warm": 1}
+    fn2 = programs.get("probe", "r", "n8", build, dtype="f32", warm=warm,
+                       iters=2)
+    assert fn2 is fn1 and calls == {"build": 1, "warm": 1}
+    assert programs.size() == 1
+    # telemetry: one miss (with its compile span feeding the compile
+    # histogram), one hit, and the counters that loadgen's attribution
+    # section diffs
+    assert len(trace.events("program-cache-miss")) == 1
+    assert len(trace.events("program-cache-hit")) == 1
+    hit = trace.events("program-cache-hit")[0]
+    assert (hit["op"], hit["rung"], hit["shape_class"]) == ("probe", "r", "n8")
+    snap = metrics.snapshot()
+    assert snap["counters"]["programs.hits"] == 1
+    assert snap["counters"]["programs.misses"] == 1
+    assert snap["histograms"]["compile.probe.n8.ms"]["count"] == 1
+
+
+def test_key_includes_statics_and_dtype():
+    built = []
+
+    def build_tagged(tag):
+        def build():
+            built.append(tag)
+            return tag
+        return build
+
+    programs.get("op", "r", "n8", build_tagged("a"), dtype="f32", iters=2)
+    programs.get("op", "r", "n8", build_tagged("b"), dtype="f32", iters=3)
+    programs.get("op", "r", "n8", build_tagged("c"), dtype="f64", iters=2)
+    programs.get("op", "r", "n8", build_tagged("d"), dtype="f32", iters=2,
+                 tile=64)
+    assert built == ["a", "b", "c", "d"]   # every variant is its own program
+    assert programs.size() == 4
+    # and the original key still hits
+    assert programs.get("op", "r", "n8", build_tagged("e"), dtype="f32",
+                        iters=2) == "a"
+
+
+def test_failed_build_or_warm_caches_nothing():
+    with pytest.raises(RuntimeError):
+        programs.get("op", "r", "n8", lambda: (_ for _ in ()).throw(
+            RuntimeError("no lowering")))
+    assert programs.size() == 0
+    with pytest.raises(RuntimeError):
+        programs.get("op", "r", "n8", lambda: "fn",
+                     warm=lambda fn: (_ for _ in ()).throw(
+                         RuntimeError("warmup died")))
+    assert programs.size() == 0
+    # the key is not poisoned: a later good build caches normally
+    assert programs.get("op", "r", "n8", lambda: "fn") == "fn"
+    assert programs.size() == 1
+
+
+def test_clear_events_resets_the_cache():
+    programs.get("op", "r", "n8", lambda: "fn")
+    assert programs.size() == 1
+    trace.clear_events()   # fresh telemetry slate implies a cold cache
+    assert programs.size() == 0 and programs.keys() == []
+
+
+# ----------------------------------------- zero-retrace second dispatch
+
+def test_spmv_second_call_is_all_hits():
+    from cme213_tpu.apps import spmv_scan as sp
+
+    prob = sp.generate_problem(256, 6, 32, iters=3, seed=11)
+    out1 = sp.run_spmv_scan(prob, kernel="flat")
+    n_miss = len(trace.events("program-cache-miss"))
+    n_hit = len(trace.events("program-cache-hit"))
+    out2 = sp.run_spmv_scan(prob, kernel="flat")
+    assert len(trace.events("program-cache-miss")) == n_miss
+    assert len(trace.events("program-cache-hit")) > n_hit
+    assert trace.events("compile-retrace") == []
+    np.testing.assert_array_equal(out1, out2)
+
+
+def test_heat_second_call_is_all_hits():
+    from cme213_tpu.config import SimParams
+    from cme213_tpu.grid import make_initial_grid
+    from cme213_tpu.ops.stencil_pipeline import run_heat_resilient
+
+    p = SimParams(nx=24, ny=24, order=2, iters=3)
+    u0 = np.asarray(make_initial_grid(p, dtype=jnp.float32))
+    r1 = run_heat_resilient(jnp.array(u0), 3, 2, p.xcfl, p.ycfl, p.bc,
+                            k=1, interpret=True)
+    n_miss = len(trace.events("program-cache-miss"))
+    r2 = run_heat_resilient(jnp.array(u0), 3, 2, p.xcfl, p.ycfl, p.bc,
+                            k=1, interpret=True)
+    assert len(trace.events("program-cache-miss")) == n_miss
+    assert trace.events("compile-retrace") == []
+    np.testing.assert_array_equal(np.asarray(r1.value), np.asarray(r2.value))
+
+
+def test_serve_cipher_second_batch_is_a_hit():
+    from cme213_tpu.serve.workloads import CipherAdapter, CipherRequest
+
+    adapter = CipherAdapter()
+    reqs = [CipherRequest(np.arange(64, dtype=np.uint8), s) for s in (3, 7)]
+    out1 = adapter.run_batch(reqs, "bytes")
+    n_miss = len(trace.events("program-cache-miss"))
+    out2 = adapter.run_batch(reqs, "bytes")
+    assert len(trace.events("program-cache-miss")) == n_miss
+    assert trace.events("program-cache-hit")
+    assert trace.events("compile-retrace") == []
+    for a, b in zip(out1, out2):
+        np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------ pad-and-mask equality
+
+def test_canonical_solve_bitwise_equals_unpadded():
+    from cme213_tpu.apps import spmv_scan as sp
+
+    # sizes straddling the mask edges: one-below-bucket (pad by 1),
+    # just-over-half (maximal pad), and exactly-on-bucket (no pad at all)
+    for n in (1023, 513, 512):
+        prob = sp.generate_problem(n, 8, 32, iters=3, seed=n)
+        base = sp.run_spmv_scan(prob, kernel="flat")
+        canon = sp.run_spmv_scan(prob, kernel="flat", canonical=True)
+        assert canon.shape == (n,)
+        np.testing.assert_array_equal(canon, base)
+        # the solve ran in the canonical class (or its own, when already
+        # canonical) — and the bucket was conformance-probed first
+        n_to = programs.canonical_size(n)
+        assert any(k[2] == f"n{n_to}/i3" for k in programs.keys())
+
+
+def test_bucket_gate_refuses_unpaddable_bucket():
+    from cme213_tpu.apps.spmv_scan import _bucket_gate
+
+    # a bucket too small to hold a strictly-smaller probe can't be proven
+    assert _bucket_gate(2, "flat", jnp.float32) is False
+
+
+def test_serve_mixed_sizes_pad_into_one_bucket_bitwise():
+    from cme213_tpu.apps import spmv_scan as sp
+    from cme213_tpu.serve.workloads import SpmvAdapter
+
+    adapter = SpmvAdapter()
+    probs = [sp.generate_problem(500, 8, 32, iters=3, seed=1),
+             sp.generate_problem(512, 8, 32, iters=3, seed=2)]
+    # near-sized requests share one canonical class -> one batched program
+    assert {adapter.shape_class(p) for p in probs} == {"n512/i3"}
+    outs = adapter.run_batch(probs, "flat")
+    for p, out in zip(probs, outs):
+        assert out.shape == (p.n,)
+        ref = sp.run_spmv_scan(p, kernel="flat")
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+# --------------------------------------------------- loadgen retrace gate
+
+def test_loadgen_max_retraces_gate(capsys):
+    from cme213_tpu.serve import loadgen
+
+    argv = ["--requests", "4", "--mode", "closed", "--concurrency", "2",
+            "--max-batch", "2", "--mix", "cipher", "--seed", "0"]
+    # the program cache holds steady-state retraces at zero even on a
+    # cold pass: every shape class compiles at most once
+    assert loadgen.main([*argv, "--max-retraces", "0"]) == 0
+    out = capsys.readouterr().out
+    assert "program cache" in out
+    # the gate trips: any retrace count exceeds a -1 ceiling
+    assert loadgen.main([*argv, "--max-retraces", "-1"]) == 1
+    assert "--max-retraces=-1" in capsys.readouterr().err
+
+
+# --------------------------------------------------- trace summary column
+
+def test_trace_summary_shows_hit_miss_column(tmp_path, monkeypatch, capsys):
+    from cme213_tpu import trace_cli
+
+    path = tmp_path / "t.jsonl"
+    monkeypatch.setenv(trace.TRACE_FILE_ENV, str(path))
+    programs.get("probe", "r", "n8", lambda: "fn")
+    programs.get("probe", "r", "n8", lambda: "fn")
+    trace.flush_sink()
+    monkeypatch.delenv(trace.TRACE_FILE_ENV)
+    capsys.readouterr()
+    assert trace_cli.main(["summary", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "hit/miss" in out
+    assert "probe [n8]" in out
+    assert "1/1" in out
+
+
+# ------------------------------------------------ persistent warm starts
+
+def test_second_process_compiles_nothing_fresh(tmp_path):
+    """The warm-start acceptance, subprocess-verified: process 1 warms
+    the cipher buckets into a persistent XLA disk cache; process 2 runs
+    the same warmup and adds ZERO entries — every program loads from
+    disk instead of compiling fresh."""
+    cache = tmp_path / "xla-cache"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "CME213_COMPILE_CACHE": str(cache)}
+    cmd = [sys.executable, "-m", "cme213_tpu", "serve", "warmup",
+           "--mix", "cipher", "--requests", "2", "--max-batch", "2",
+           "--json"]
+
+    def run():
+        r = subprocess.run(cmd, env=env, cwd=REPO_ROOT, timeout=300,
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        return json.loads(r.stdout)
+
+    rep1 = run()
+    assert rep1["warmed"] and rep1["programs"] > 0
+    entries = rep1["persistent_entries"]
+    if not entries:
+        pytest.skip("backend wrote no persistent compilation cache entries")
+    assert rep1["compile"]["cache_misses"] > 0
+    rep2 = run()
+    # zero fresh entries persisted: the disk cache served every compile
+    assert rep2["persistent_entries"] == entries
+    assert rep2["warmed"] == rep1["warmed"]
