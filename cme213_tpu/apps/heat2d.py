@@ -294,6 +294,11 @@ def run_distributed_supervised(params: SimParams,
 
 
 def main(argv: list[str]) -> int:
+    # supervised workers inherit CME213_FLIGHT_DIR from the launcher; a
+    # rank dying uncleanly then leaves a per-rank flight dump behind
+    from ..core import flight
+
+    flight.install_from_env()
     paths = [a for a in argv[1:] if not a.startswith("--")]
     path = paths[0] if paths else "params.in"
     distributed = "--distributed" in argv

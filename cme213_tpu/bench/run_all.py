@@ -40,8 +40,10 @@ import time
 
 
 def main(argv=None) -> int:
+    from ..core import flight
     from . import sweeps
 
+    flight.install()   # a crashed sweep leaves its black box behind
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="bench_results")
     ap.add_argument("--quick", action="store_true",

@@ -201,9 +201,13 @@ def run_with_checkpoints(step_fn, state, total_iters: int, path: str,
     emits a ``chunk-shrunk`` event; a RESOURCE failure at chunk length 1
     re-raises (no smaller program exists).
     """
+    from . import flight
     from .faults import maybe_oom, maybe_poison
     from .resilience import FailureKind, NonFiniteError, classify_failure
 
+    # a checkpointed solve is a *long* solve: arm the flight recorder
+    # (only when CME213_FLIGHT_DIR opts in — this is a library path)
+    flight.install_from_env()
     chunk_op = chunk_op or f"{op}_chunk"
     start = 0
     loaded = load_checkpoint(path)

@@ -53,15 +53,19 @@ Spec grammar (comma-separated clauses)::
                                   out-of-memory the admission layer
                                   (``core/admission.py``) degrades under;
                                   first incarnation only
-    slow:<op>[:<ms>[:<nth>]]      the <nth> call (1-based, default 1) of
-                                  ``maybe_slow(op)`` injects <ms>
+    slow:<op>[:<ms>[:<nth>[:<count>]]]
+                                  calls <nth> .. <nth>+<count>-1 (1-based,
+                                  default nth 1, count 1) of
+                                  ``maybe_slow(op)`` inject <ms>
                                   milliseconds of latency (default 100) —
                                   the deterministic straggler the serving
                                   layer's deadline/degradation paths are
-                                  tested against on CPU; the sleep hook is
-                                  injectable so tests advance a virtual
-                                  clock instead of waiting wall-time;
-                                  first incarnation only
+                                  tested against on CPU; a large <count>
+                                  models *sustained* overload (what trips
+                                  the SLO burn-rate monitor); the sleep
+                                  hook is injectable so tests advance a
+                                  virtual clock instead of waiting
+                                  wall-time; first incarnation only
 
 Op names are dotted paths (``spmv_scan.pallas-fused``, ``heat.pipeline``,
 ``sweep.heat_bandwidth``); colons are reserved for the grammar.
@@ -102,7 +106,7 @@ class _Clause:
     kind: str           # fail | nan | ckpt | rankkill | wrong | oom | slow
     op: str             # op name ("truncate" for ckpt; rank id for rankkill)
     nth: int = 1        # 1-based trigger call (rankkill: 0-based step)
-    count: int = 1      # consecutive triggered calls (fail only)
+    count: int = 1      # consecutive triggered calls (fail/slow)
     ms: float = 0.0     # injected latency (slow only)
     calls: int = 0      # mutable per-clause call counter
 
@@ -130,7 +134,7 @@ class FaultPlan:
                 raise FaultSpecError(
                     f"bad fault clause {raw!r} (kinds: fail:<op>[:nth[:count]]"
                     f", nan:<op>[:nth], wrong:<op>[:nth], oom:<op>[:nth], "
-                    f"slow:<op>[:ms[:nth]], ckpt:truncate[:nth], "
+                    f"slow:<op>[:ms[:nth[:count]]], ckpt:truncate[:nth], "
                     f"rankkill:<rank>[:step])")
             try:
                 if kind == "fail":
@@ -145,7 +149,8 @@ class FaultPlan:
                             f"slow clause needs ms >= 0, got {ms}")
                     clauses.append(_Clause(
                         kind, parts[1], ms=ms,
-                        nth=int(parts[3]) if len(parts) > 3 else 1))
+                        nth=int(parts[3]) if len(parts) > 3 else 1,
+                        count=int(parts[4]) if len(parts) > 4 else 1))
                 elif kind in ("nan", "wrong", "oom"):
                     clauses.append(_Clause(
                         kind, parts[1],
@@ -383,4 +388,8 @@ def maybe_kill_rank(step: int | None = None) -> None:
             sys.stderr.write(
                 f"[faults] injected kill: rank {rank} at step {at}\n")
             sys.stderr.flush()
+            # os._exit skips atexit AND sys.excepthook — the flight
+            # recorder must dump here or the event ring dies with us
+            from . import flight
+            flight.dump("rankkill")
             os._exit(KILL_EXIT)

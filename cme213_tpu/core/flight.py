@@ -1,0 +1,220 @@
+"""Crash flight recorder — the black box for uncleanly dying processes.
+
+The trace layer (``core/trace.py``) keeps an in-process event ring
+(``CME213_TRACE_BUFFER``) and optionally streams to a JSONL sink; both
+are great while the process lives, but a rank that dies uncleanly — the
+exact scenario the supervision ladder (``dist/launch.py``) hardens
+against — takes its in-memory ring with it, and a sink only helps when
+one was configured.  This module is the always-available fallback: on an
+unhandled exception, a fatal signal, or an explicit ``dump()`` it
+atomically writes the last-N events, a metrics snapshot, the still-open
+spans, and platform info to ``flight-<pid>-<ts>.json`` so every gang
+failure is diagnosable from artifacts alone.
+
+Usage::
+
+    from cme213_tpu.core import flight
+    flight.install()              # CLI entry points: always record
+    flight.install_from_env()     # library paths: only when
+                                  # CME213_FLIGHT_DIR is set
+
+``install()`` chains ``sys.excepthook`` and registers handlers for the
+fatal-ish signals a supervisor sends (SIGTERM, SIGQUIT, SIGABRT —
+SIGKILL is uncatchable by definition, which is what the ``rankkill``
+fault's direct ``dump()`` call covers).  Dumps land in
+``CME213_FLIGHT_DIR`` when set, else the install-time directory, else
+the current working directory.  Writes are tmp + ``os.replace`` so a
+reader never sees a torn JSON file.  Rendering: ``python -m cme213_tpu
+trace flight <dump>`` (``trace_cli.py``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import platform as _platform
+import signal
+import sys
+import threading
+import time
+import traceback
+
+from . import metrics, trace
+
+#: directory flight dumps are written to (also arms library-path dumps)
+FLIGHT_DIR_ENV = "CME213_FLIGHT_DIR"
+
+#: events retained in a dump (the tail of the trace ring)
+DUMP_EVENTS = 512
+
+#: signals that trigger a dump before the process dies (SIGKILL cannot be
+#: caught; ``faults.maybe_kill_rank`` dumps explicitly instead)
+FATAL_SIGNALS = ("SIGTERM", "SIGQUIT", "SIGABRT")
+
+_LOCK = threading.Lock()
+_INSTALLED = False
+_DIR: str | None = None
+_PREV_EXCEPTHOOK = None
+_PLATFORM: dict | None = None
+_DUMP_SEQ = itertools.count(1)
+_DUMPING = False
+
+
+def _platform_info() -> dict:
+    """Cheap once-per-install platform facts (never imports jax — reads
+    the version only if something else already loaded it)."""
+    jax_mod = sys.modules.get("jax")
+    return {
+        "python": sys.version.split()[0],
+        "platform": _platform.platform(),
+        "jax": getattr(jax_mod, "__version__", None),
+        "argv": list(sys.argv),
+    }
+
+
+def installed() -> bool:
+    return _INSTALLED
+
+
+def _armed() -> bool:
+    """Dumps happen when hooks were installed or the env var opts in."""
+    return _INSTALLED or bool(os.environ.get(FLIGHT_DIR_ENV))
+
+
+def _dump_dir() -> str:
+    return os.environ.get(FLIGHT_DIR_ENV) or _DIR or os.getcwd()
+
+
+def _open_spans(events: list[dict]) -> list[dict]:
+    """span-begin records without a matching span-end — what the process
+    was inside when it died."""
+    open_by_id: dict = {}
+    for e in events:
+        if e.get("event") == "span-begin":
+            open_by_id[e.get("id")] = e
+        elif e.get("event") == "span-end":
+            open_by_id.pop(e.get("id"), None)
+    return list(open_by_id.values())
+
+
+def dump(reason: str, exc: BaseException | None = None) -> str | None:
+    """Write a flight dump now; returns its path.
+
+    No-op (returns None) unless armed via ``install()``/
+    ``install_from_env()`` or a set ``CME213_FLIGHT_DIR`` — library code
+    can call this unconditionally on its failure paths.  Re-entrant calls
+    (a dump failing inside a dump) are dropped rather than recursing.
+    """
+    global _DUMPING
+    if not _armed():
+        return None
+    with _LOCK:
+        if _DUMPING:
+            return None
+        _DUMPING = True
+    try:
+        events = trace.events()[-DUMP_EVENTS:]
+        doc = {
+            "flight": 1,
+            "reason": reason,
+            "t": round(time.time(), 6),
+            "pid": os.getpid(),
+            "rank": os.environ.get("JAX_PROCESS_ID"),
+            "incarnation": os.environ.get("CME213_INCARNATION", "0"),
+            "platform": _PLATFORM or _platform_info(),
+            "traceback": ("".join(traceback.format_exception(
+                type(exc), exc, exc.__traceback__)) if exc else None),
+            "open_spans": _open_spans(events),
+            "events": events,
+            "metrics": metrics.snapshot(),
+        }
+        out_dir = _dump_dir()
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(
+            out_dir,
+            f"flight-{os.getpid()}-{int(time.time() * 1000)}"
+            f"-{next(_DUMP_SEQ)}.json")
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        trace.record_event("flight-dump", reason=reason, path=path,
+                           events=len(events))
+        trace.flush_sink()
+        return path
+    except Exception:
+        return None  # the recorder must never mask the original failure
+    finally:
+        with _LOCK:
+            _DUMPING = False
+
+
+def _excepthook(exc_type, exc, tb):
+    dump("unhandled-exception", exc=exc)
+    hook = _PREV_EXCEPTHOOK or sys.__excepthook__
+    hook(exc_type, exc, tb)
+
+
+def _signal_handler(signum, frame):
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:
+        name = str(signum)
+    dump(f"signal:{name}")
+    # die with the signal's own semantics (exit status, core dump, the
+    # supervisor's SIGKILL escalation) rather than swallowing it
+    signal.signal(signum, signal.SIG_DFL)
+    os.kill(os.getpid(), signum)
+
+
+def install(dir: str | None = None) -> None:
+    """Arm the recorder: chain ``sys.excepthook`` and register fatal
+    signal handlers.  Idempotent; safe from any thread (signal handlers
+    are skipped off the main thread — the excepthook still works)."""
+    global _INSTALLED, _DIR, _PREV_EXCEPTHOOK, _PLATFORM
+    with _LOCK:
+        if dir:
+            _DIR = dir
+        if _INSTALLED:
+            return
+        _INSTALLED = True
+        _PLATFORM = _platform_info()
+        _PREV_EXCEPTHOOK = sys.excepthook
+    sys.excepthook = _excepthook
+    for sig_name in FATAL_SIGNALS:
+        sig = getattr(signal, sig_name, None)
+        if sig is None:
+            continue
+        try:
+            existing = signal.getsignal(sig)
+            # don't stomp an application handler; default/ignore is ours
+            if existing in (signal.SIG_DFL, signal.SIG_IGN, None):
+                signal.signal(sig, _signal_handler)
+        except (ValueError, OSError):
+            pass  # non-main thread or unsupported signal
+
+
+def install_from_env() -> bool:
+    """``install()`` only when ``CME213_FLIGHT_DIR`` is set — the opt-in
+    for library paths (serving loop, checkpointed solves) where an
+    unconditional excepthook swap would surprise embedders."""
+    if os.environ.get(FLIGHT_DIR_ENV):
+        install()
+        return True
+    return False
+
+
+def _uninstall_for_tests() -> None:
+    """Reset module state (tests only — does not restore signal
+    dispositions)."""
+    global _INSTALLED, _DIR, _PREV_EXCEPTHOOK, _PLATFORM
+    with _LOCK:
+        if _INSTALLED and _PREV_EXCEPTHOOK is not None:
+            sys.excepthook = _PREV_EXCEPTHOOK
+        _INSTALLED = False
+        _DIR = None
+        _PREV_EXCEPTHOOK = None
+        _PLATFORM = None

@@ -3,10 +3,13 @@
 The reference derived every metric offline, in spreadsheets over printed
 timer lines (SURVEY §5); a production system pulls named metrics from the
 process instead (the Prometheus model).  This registry is that pull
-surface, deliberately tiny: no label sets, no exposition server — just
-named instruments a solver increments on its host path and a
-``snapshot()`` the bench harness (``bench/run_all.py``) and the trace
-sink (a ``metrics-snapshot`` event at exit) serialize::
+surface, deliberately tiny: no exposition server — just named
+instruments a solver increments on its host path, a ``snapshot()`` the
+bench harness (``bench/run_all.py``) and the trace sink (a
+``metrics-snapshot`` event at exit) serialize, and a
+``render_prometheus()`` text rendering (dotted-name suffixes folded
+into labels for the known families) that ``write_exposition()`` dumps
+atomically to ``CME213_METRICS_FILE`` for external scrapers::
 
     from cme213_tpu.core import metrics
     metrics.counter("fallback.demotions").inc()
@@ -28,8 +31,17 @@ row set in ``metrics.json``.
 from __future__ import annotations
 
 import atexit
+import math
+import os
+import re
 import threading
 from collections import deque
+
+#: optional path for a Prometheus text-format dump, written atomically at
+#: interpreter exit (and periodically by long-running callers such as the
+#: serving loop) so external scrapers read live state without parsing
+#: trace JSONL
+METRICS_FILE_ENV = "CME213_METRICS_FILE"
 
 #: observations retained per histogram for percentile estimates
 KEEP = 4096
@@ -95,22 +107,25 @@ class Histogram:
         return self
 
     def percentile(self, q: float) -> float | None:
-        """Nearest-rank percentile (q in [0, 1]) over retained
-        observations; None when empty."""
+        """Nearest-rank percentile over the retained window.
+
+        ``q`` is a fraction in [0, 1].  The result is the nearest-rank
+        order statistic — ``sorted(window)[ceil(q * n) - 1]`` (clamped to
+        the ends, so ``q=0`` is the window minimum and ``q=1`` the window
+        maximum) — computed over the last ``KEEP`` observations only:
+        once the ring has wrapped, older observations no longer influence
+        percentiles (count/sum/min/max stay exact over the full stream).
+        Returns None when no observations were retained.
+        """
         with _LOCK:
             vals = sorted(self._recent)
-        if not vals:
-            return None
-        idx = min(len(vals) - 1, max(0, round(q * (len(vals) - 1))))
-        return vals[idx]
+        return _nearest_rank(vals, q)
 
     def _summary_locked(self) -> dict:
         vals = sorted(self._recent)
 
         def pct(q):
-            if not vals:
-                return None
-            return vals[min(len(vals) - 1, max(0, round(q * (len(vals) - 1))))]
+            return _nearest_rank(vals, q)
 
         return {
             "count": self.count,
@@ -122,6 +137,19 @@ class Histogram:
             "p90": pct(0.90),
             "p99": pct(0.99),
         }
+
+
+def _nearest_rank(sorted_vals, q: float) -> float | None:
+    """Nearest-rank order statistic of pre-sorted values; None if empty.
+
+    Rank is ``ceil(q * n)`` (1-based), clamped into [1, n] so q=0 yields
+    the minimum and q=1 the maximum of the given window.
+    """
+    n = len(sorted_vals)
+    if not n:
+        return None
+    rank = math.ceil(q * n)
+    return sorted_vals[min(n - 1, max(0, rank - 1))]
 
 
 def counter(name: str) -> Counter:
@@ -180,6 +208,110 @@ def delta(before: dict, after: dict) -> dict:
             "histograms": histograms}
 
 
+#: metric-name characters Prometheus allows; everything else becomes "_"
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+#: dotted-name families whose trailing segments are really label values;
+#: (regex, family name, label names) — first match wins, everything else
+#: renders as a flat sanitized name
+_LABEL_FAMILIES = (
+    (re.compile(r"^serve\.shed\.(?P<reason>.+)$"),
+     "serve_shed_total", ("reason",)),
+    (re.compile(r"^serve\.tenant\.(?P<tenant>[^.]+)\.(?P<what>[^.]+)$"),
+     None, ("tenant",)),          # family derived from <what> below
+    (re.compile(r"^served\.(?P<op>[^.]+)\.(?P<rung>[^.]+)$"),
+     "served_total", ("op", "rung")),
+    (re.compile(r"^faults\.(?P<kind>.+)$"),
+     "faults_total", ("kind",)),
+)
+
+
+def _sanitize_name(name: str) -> str:
+    return _NAME_BAD.sub("_", name)
+
+
+def _escape_label(value) -> str:
+    return (str(value).replace("\\", "\\\\")
+            .replace('"', '\\"').replace("\n", "\\n"))
+
+
+def _counter_series(name: str) -> tuple[str, str]:
+    """Map a dotted counter name to (family, labels-suffix).
+
+    Known families (shed reasons, per-tenant counters, per-rung serve
+    counts, fault kinds) fold their trailing segments into labels so a
+    scraper sees one time series per family; anything else renders flat.
+    """
+    for rx, family, label_names in _LABEL_FAMILIES:
+        m = rx.match(name)
+        if not m:
+            continue
+        if family is None:  # serve.tenant.<t>.<what> -> per-<what> family
+            family = f"serve_tenant_{_sanitize_name(m.group('what'))}_total"
+        labels = ",".join(f'{ln}="{_escape_label(m.group(ln))}"'
+                          for ln in label_names)
+        return f"cme213_{family}", "{" + labels + "}"
+    return f"cme213_{_sanitize_name(name)}_total", ""
+
+
+def render_prometheus(snap: dict | None = None) -> str:
+    """Render a snapshot (default: the live registry) in the Prometheus
+    text exposition format.
+
+    Counters become ``cme213_<name>_total``; a few dotted families
+    (``serve.shed.<reason>``, ``serve.tenant.<t>.<what>``,
+    ``served.<op>.<rung>``, ``faults.<kind>``) fold their variable
+    segments into labels.  Numeric gauges render as gauges (non-numeric
+    gauge values are skipped — Prometheus has no string samples).
+    Histograms render as summaries: ``{quantile="0.5|0.9|0.99"}`` lines
+    from the retained window plus exact ``_sum``/``_count``.
+    """
+    snap = snapshot() if snap is None else snap
+    lines: list[str] = []
+
+    families: dict[str, list[str]] = {}
+    for name, value in snap.get("counters", {}).items():
+        family, labels = _counter_series(name)
+        families.setdefault(family, []).append(f"{family}{labels} {value}")
+    for family in sorted(families):
+        lines.append(f"# TYPE {family} counter")
+        lines.extend(sorted(families[family]))
+
+    for name, value in snap.get("gauges", {}).items():
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        pname = f"cme213_{_sanitize_name(name)}"
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname} {value}")
+
+    for name, h in snap.get("histograms", {}).items():
+        pname = f"cme213_{_sanitize_name(name)}"
+        lines.append(f"# TYPE {pname} summary")
+        for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+            if h.get(key) is not None:
+                lines.append(f'{pname}{{quantile="{q}"}} {h[key]}')
+        lines.append(f"{pname}_sum {h.get('sum', 0)}")
+        lines.append(f"{pname}_count {h.get('count', 0)}")
+
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def write_exposition(path: str | None = None) -> str | None:
+    """Atomically dump ``render_prometheus()`` to ``path`` (default: the
+    ``CME213_METRICS_FILE`` env var).  Returns the path written, or None
+    when no destination is configured.  tmp + ``os.replace`` so a scraper
+    racing the writer never reads a torn file."""
+    path = path or os.environ.get(METRICS_FILE_ENV)
+    if not path:
+        return None
+    text = render_prometheus()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
 def reset() -> None:
     """Forget every instrument (tests)."""
     with _LOCK:
@@ -198,6 +330,10 @@ def _emit_exit_snapshot() -> None:
 
     record_event("metrics-snapshot", metrics=snapshot())
     flush_sink()
+    try:
+        write_exposition()
+    except OSError:
+        pass  # a dead exposition path must not mask the real exit cause
 
 
 atexit.register(_emit_exit_snapshot)

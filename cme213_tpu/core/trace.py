@@ -115,9 +115,17 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "breaker-half-open": ("op", "rung"),
     "breaker-close": ("op", "rung"),
     # serving front end (serve/server.py)
-    "queue-shed": ("op", "reason", "depth"),
-    "deadline-shed": ("op", "rid", "late_ms"),
+    "queue-shed": ("op", "reason", "depth", "age_ms"),
+    "deadline-shed": ("op", "rid", "late_ms", "depth", "age_ms"),
     "batch-executed": ("op", "shape_class", "size", "occupancy"),
+    # request lifecycle (serve/server.py): one per served/failed request,
+    # linking the request id to the batch span that executed it
+    "request-served": ("rid", "op", "tenant", "batch", "status", "total_ms"),
+    # SLO burn-rate monitor (serve/slo.py)
+    "slo-burn": ("objective", "burn_short", "burn_long", "threshold"),
+    "slo-ok": ("objective", "burn_short"),
+    # flight recorder (core/flight.py)
+    "flight-dump": ("reason", "path", "events"),
     # telemetry itself
     "span-begin": ("span", "id", "parent"),
     "span-end": ("span", "id", "parent", "ms"),

@@ -368,6 +368,11 @@ def main(argv=None) -> int:
     cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
     if not cmd:
         ap.error("no command given (append: -- python your_script.py)")
+    # the launcher records its own black box; workers inherit
+    # CME213_FLIGHT_DIR through the env and arm their own recorders
+    from ..core import flight
+
+    flight.install()
     if args.stall_timeout is not None:
         return launch_supervised(
             args.np_procs, cmd, args.devices_per_proc,

@@ -102,7 +102,8 @@ WORKLOADS: dict[str, Workload] = {
         # telemetry sinks every workload above writes (SURVEY §5's
         # spreadsheet step, made a first-class tool)
         Workload("trace", "telemetry", "summary | timeline | merge | "
-                 "export (Perfetto) | regress over CME213_TRACE_FILE "
+                 "export (Perfetto) | regress | metrics (Prometheus "
+                 "text) | flight (crash dump) over CME213_TRACE_FILE "
                  "JSON-lines traces and bench artifacts", _trace),
         # not a reference workload: the multi-tenant front end serving
         # the workloads above as a request population (bounded queue,

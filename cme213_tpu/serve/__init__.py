@@ -14,6 +14,7 @@ from .request import (  # noqa: F401
     DEADLINE,
     FAILED,
     OK,
+    PHASES,
     QUEUE_FULL,
     SHED,
     RequestSpec,
@@ -21,6 +22,7 @@ from .request import (  # noqa: F401
     SolveResult,
 )
 from .server import BoundedQueue, Server  # noqa: F401
+from .slo import Objective, SLOMonitor  # noqa: F401
 from .workloads import ADAPTERS, CipherRequest  # noqa: F401
 
 

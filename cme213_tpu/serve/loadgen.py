@@ -33,9 +33,11 @@ import sys
 
 import numpy as np
 
-from ..core import metrics
+from ..core import flight, metrics, trace
+from ..core.metrics import _nearest_rank
 from ..core.resilience import Clock
-from .request import OK, SHED, FAILED, RequestSpec
+from . import slo as slo_mod
+from .request import OK, SHED, FAILED, PHASES, RequestSpec
 from .server import Server
 
 #: ops the ``--mix`` flag accepts, comma-separated
@@ -43,11 +45,14 @@ MIX_OPS = ("spmv", "heat", "cipher")
 
 
 def build_mix(mix: str, requests: int, seed: int = 0,
-              deadline_ms: float | None = None) -> list[RequestSpec]:
+              deadline_ms: float | None = None,
+              tenants: int = 1) -> list[RequestSpec]:
     """The synthetic request population: ``requests`` specs cycling
     through the ops named in ``mix``, shapes chosen so that same-op
     requests recur in a handful of shape classes (batching has something
-    to coalesce) without being identical payloads."""
+    to coalesce) without being identical payloads.  ``tenants`` > 1
+    round-robins the specs over tenants ``t0..t{n-1}`` so per-tenant
+    attribution has something to attribute."""
     ops = [o.strip() for o in mix.split(",") if o.strip()]
     unknown = [o for o in ops if o not in MIX_OPS]
     if unknown:
@@ -56,6 +61,7 @@ def build_mix(mix: str, requests: int, seed: int = 0,
     specs: list[RequestSpec] = []
     for i in range(requests):
         op = ops[i % len(ops)]
+        tenant = f"t{i % tenants}" if tenants > 1 else "default"
         if op == "spmv":
             from ..apps.spmv_scan import generate_problem
 
@@ -63,21 +69,21 @@ def build_mix(mix: str, requests: int, seed: int = 0,
             prob = generate_problem(n, p=max(2, n // 64), q=n // 2,
                                     iters=6, seed=seed + i)
             specs.append(RequestSpec("spmv_scan", prob,
-                                     deadline_ms=deadline_ms))
+                                     deadline_ms=deadline_ms, tenant=tenant))
         elif op == "heat":
             from ..config import SimParams
 
             params = SimParams(nx=24, ny=24, order=2, iters=4,
                                alpha=float(rng.uniform(0.5, 2.0)))
             specs.append(RequestSpec("heat", params,
-                                     deadline_ms=deadline_ms))
+                                     deadline_ms=deadline_ms, tenant=tenant))
         else:
             from .workloads import CipherRequest
 
             text = rng.integers(0, 200, size=4096).astype(np.uint8)
             specs.append(RequestSpec(
                 "cipher", CipherRequest(text, int(rng.integers(0, 56))),
-                deadline_ms=deadline_ms))
+                deadline_ms=deadline_ms, tenant=tenant))
     return specs
 
 
@@ -96,7 +102,8 @@ def run_load(server: Server, specs: list[RequestSpec],
             while pending and inflight < concurrency:
                 spec = pending.pop(0)
                 out = server.submit(spec.op, spec.payload,
-                                    deadline_ms=spec.deadline_ms)
+                                    deadline_ms=spec.deadline_ms,
+                                    tenant=spec.tenant)
                 if isinstance(out, int):
                     inflight += 1
                 else:
@@ -109,7 +116,8 @@ def run_load(server: Server, specs: list[RequestSpec],
         while pending:
             for spec in pending[:burst]:
                 out = server.submit(spec.op, spec.payload,
-                                    deadline_ms=spec.deadline_ms)
+                                    deadline_ms=spec.deadline_ms,
+                                    tenant=spec.tenant)
                 if not isinstance(out, int):
                     results.append(out)
             pending = pending[burst:]
@@ -163,11 +171,62 @@ def compile_attribution(before: dict, after: dict) -> dict:
     }
 
 
-def slo_report(run: dict, before: dict, after: dict) -> dict:
+def _pcts(values) -> dict | None:
+    """{p50, p99} by nearest rank, or None with no samples."""
+    vals = sorted(v for v in values if v is not None)
+    if not vals:
+        return None
+    return {"p50": round(_nearest_rank(vals, 0.50), 3),
+            "p99": round(_nearest_rank(vals, 0.99), 3)}
+
+
+def phase_attribution(served) -> dict:
+    """Per-op (plus ``overall``) p50/p99 for each lifecycle phase, from
+    the served results' ``timing`` breakdowns."""
+    by_op: dict[str, list] = {}
+    for r in served:
+        if r.timing:
+            by_op.setdefault(r.op, []).append(r.timing)
+    out: dict[str, dict] = {}
+    groups = {"overall": [t for ts in by_op.values() for t in ts], **by_op}
+    for group, timings in groups.items():
+        row = {}
+        for phase in PHASES + ("total",):
+            p = _pcts(t.get(f"{phase}_ms") for t in timings)
+            if p is not None:
+                row[phase] = p
+        if row:
+            out[group] = row
+    return out
+
+
+def tenant_attribution(results) -> dict:
+    """Per-tenant request accounting + served-latency percentiles."""
+    out: dict[str, dict] = {}
+    for r in results:
+        row = out.setdefault(r.tenant, {"requests": 0, "served": 0,
+                                        "shed": 0, "failed": 0,
+                                        "_lat": []})
+        row["requests"] += 1
+        if r.status == OK:
+            row["served"] += 1
+            if r.latency_ms is not None:
+                row["_lat"].append(r.latency_ms)
+        elif r.status == SHED:
+            row["shed"] += 1
+        else:
+            row["failed"] += 1
+    for row in out.values():
+        row["latency_ms"] = _pcts(row.pop("_lat"))
+    return out
+
+
+def slo_report(run: dict, before: dict, after: dict, slo=None) -> dict:
     """The SLO view of a :func:`run_load` run: latency percentiles over
-    served requests, throughput, shed accounting, breaker transitions —
-    computed from the results plus the metrics-registry delta (the same
-    numbers ``trace summary`` reads from the trace file)."""
+    served requests, throughput, shed accounting, breaker transitions,
+    per-phase and per-tenant attribution — computed from the results plus
+    the metrics-registry delta (the same numbers ``trace summary`` reads
+    from the trace file)."""
     results = run["results"]
     served = [r for r in results if r.status == OK]
     shed = [r for r in results if r.status == SHED]
@@ -175,9 +234,8 @@ def slo_report(run: dict, before: dict, after: dict) -> dict:
     lat = sorted(r.latency_ms for r in served if r.latency_ms is not None)
 
     def pct(q):
-        if not lat:
-            return None
-        return round(lat[min(len(lat) - 1, max(0, round(q * (len(lat) - 1))))], 3)
+        v = _nearest_rank(lat, q)
+        return None if v is None else round(v, 3)
 
     d = metrics.delta(before, after)
     counters = d["counters"]
@@ -210,6 +268,13 @@ def slo_report(run: dict, before: dict, after: dict) -> dict:
         },
         "demotions": counters.get("fallback.demotions", 0),
         "compile": compile_attribution(before, after),
+        "phases": phase_attribution(served),
+        "tenants": tenant_attribution(results),
+        "slo": {
+            "objectives": slo.state() if slo is not None else {},
+            "burn_events": len(trace.events("slo-burn")),
+            "ok_events": len(trace.events("slo-ok")),
+        },
     }
 
 
@@ -250,6 +315,33 @@ def format_report(report: dict) -> str:
             lines.append(
                 f"  {key}: compile {row['compile_ms']} ms "
                 f"x{row['compiles']}, run {row['run_ms']} ms x{row['runs']}")
+    phases = report.get("phases") or {}
+    if "overall" in phases:
+        lines.append("phase attribution (p50/p99 ms):")
+        for group in sorted(phases, key=lambda g: (g != "overall", g)):
+            row = phases[group]
+            cells = "  ".join(
+                f"{ph} {row[ph]['p50']}/{row[ph]['p99']}"
+                for ph in PHASES + ("total",) if ph in row)
+            lines.append(f"  {group}: {cells}")
+    tenants = report.get("tenants") or {}
+    if len(tenants) > 1 or (tenants and "default" not in tenants):
+        lines.append("tenants:")
+        for t in sorted(tenants):
+            row = tenants[t]
+            lm = row["latency_ms"]
+            tail = (f", p50 {lm['p50']} p99 {lm['p99']} ms" if lm else "")
+            lines.append(f"  {t}: {row['served']}/{row['requests']} served, "
+                         f"{row['shed']} shed, {row['failed']} failed{tail}")
+    slo_sec = report.get("slo") or {}
+    if slo_sec.get("objectives") or slo_sec.get("burn_events"):
+        lines.append(f"slo: {slo_sec.get('burn_events', 0)} burn / "
+                     f"{slo_sec.get('ok_events', 0)} ok transitions")
+        for name, st in sorted((slo_sec.get("objectives") or {}).items()):
+            lines.append(
+                f"  {name} ({st['kind']} target {st['target']}): "
+                f"burn short {st['burn_short']} long {st['burn_long']}"
+                f"{'  BURNING' if st['burning'] else ''}")
     if "baseline" in report:
         b = report["baseline"]
         lines.append(f"baseline (max_batch=1): {b['throughput_rps']} req/s "
@@ -275,8 +367,22 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--mix", default="spmv,heat,cipher",
                     help=f"comma-separated ops from {MIX_OPS}")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tenants", type=int, default=1,
+                    help="round-robin requests over this many tenants "
+                    "(t0..tN-1) for per-tenant attribution")
     ap.add_argument("--degrade-depth", type=int, default=None)
     ap.add_argument("--degrade-p99-ms", type=float, default=None)
+    ap.add_argument("--slo-p99-ms", type=float, default=None,
+                    help="p99 latency objective (ms); arms the SLO "
+                    "burn-rate monitor as a degraded-mode trigger")
+    ap.add_argument("--slo-shed-rate", type=float, default=None,
+                    help="shed-rate budget objective (fraction)")
+    ap.add_argument("--slo-error-rate", type=float, default=None,
+                    help="error-rate budget objective (fraction)")
+    ap.add_argument("--slo-short-s", type=float, default=5.0)
+    ap.add_argument("--slo-long-s", type=float, default=60.0)
+    ap.add_argument("--slo-burn-threshold", type=float, default=2.0)
+    ap.add_argument("--slo-min-samples", type=int, default=10)
     ap.add_argument("--breaker-threshold", type=int, default=3)
     ap.add_argument("--breaker-cooldown-s", type=float, default=30.0)
     ap.add_argument("--baseline", action="store_true",
@@ -294,15 +400,26 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
+    flight.install()   # a crashing load run leaves its black box behind
     specs = build_mix(args.mix, args.requests, seed=args.seed,
-                      deadline_ms=args.deadline_ms)
+                      deadline_ms=args.deadline_ms, tenants=args.tenants)
+    last_slo = None
 
     def make_server(max_batch: int) -> Server:
+        nonlocal last_slo
+        clock = Clock()
+        last_slo = slo_mod.from_flags(
+            clock, p99_ms=args.slo_p99_ms, shed_rate=args.slo_shed_rate,
+            error_rate=args.slo_error_rate, short_s=args.slo_short_s,
+            long_s=args.slo_long_s, burn_threshold=args.slo_burn_threshold,
+            min_samples=args.slo_min_samples)
         return Server(capacity=args.capacity, max_batch=max_batch,
+                      clock=clock,
                       breaker_threshold=args.breaker_threshold,
                       breaker_cooldown_s=args.breaker_cooldown_s,
                       degrade_depth=args.degrade_depth,
-                      degrade_p99_ms=args.degrade_p99_ms)
+                      degrade_p99_ms=args.degrade_p99_ms,
+                      slo=last_slo)
 
     def run_pass(max_batch: int) -> dict:
         return run_load(make_server(max_batch), specs, mode=args.mode,
@@ -330,7 +447,7 @@ def main(argv: list[str]) -> int:
         run_pass(args.max_batch)
     before = metrics.snapshot()
     run = run_pass(args.max_batch)
-    report = slo_report(run, before, metrics.snapshot())
+    report = slo_report(run, before, metrics.snapshot(), slo=last_slo)
     if baseline is not None:
         speedup = None
         if baseline["throughput_rps"] and report["throughput_rps"]:

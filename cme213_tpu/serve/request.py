@@ -30,6 +30,11 @@ DEADLINE = "deadline"
 ADMISSION = "admission"
 
 
+#: lifecycle phase names, in stamp order (the ``timing`` dict keys are
+#: ``<phase>_ms`` plus ``total_ms``)
+PHASES = ("queue", "admit", "batch_wait", "run")
+
+
 @dataclass
 class SolveRequest:
     rid: int                      # server-assigned, unique per server
@@ -37,6 +42,28 @@ class SolveRequest:
     payload: object               # op-specific problem description
     submitted_s: float            # server-clock time of acceptance
     deadline_s: float | None = None   # absolute server-clock deadline
+    tenant: str = "default"       # billing/attribution principal
+    # lifecycle phase stamps, all on the server clock (monotonic within a
+    # request by construction: stamped in submit/step/execute order)
+    dequeued_s: float | None = None   # pulled into a candidate batch
+    admitted_s: float | None = None   # cleared the admission preflight
+    executed_s: float | None = None   # handed to the kernel ladder
+    completed_s: float | None = None  # ladder returned
+
+    def timing(self) -> dict:
+        """Phase breakdown in ms (``queue``/``admit``/``batch_wait``/
+        ``run`` + ``total``); phases not reached are None.  Sums of the
+        reached phases equal ``total_ms`` up to rounding — every stamp
+        comes from the same clock."""
+        def ms(a, b):
+            return None if (a is None or b is None) else round((b - a) * 1e3, 3)
+        return {
+            "queue_ms": ms(self.submitted_s, self.dequeued_s),
+            "admit_ms": ms(self.dequeued_s, self.admitted_s),
+            "batch_wait_ms": ms(self.admitted_s, self.executed_s),
+            "run_ms": ms(self.executed_s, self.completed_s),
+            "total_ms": ms(self.submitted_s, self.completed_s),
+        }
 
 
 @dataclass
@@ -51,6 +78,8 @@ class SolveResult:
     latency_ms: float | None = None   # submit -> completion (server clock)
     batch_size: int | None = None     # lanes in the serving program
     degraded: bool = False            # served under degraded mode
+    tenant: str = "default"           # principal the request ran under
+    timing: dict | None = None        # phase breakdown (SolveRequest.timing)
 
     @property
     def ok(self) -> bool:
@@ -66,3 +95,4 @@ class RequestSpec:
     payload: object
     deadline_ms: float | None = None
     tags: dict = field(default_factory=dict)
+    tenant: str = "default"

@@ -22,12 +22,20 @@ is observable:
   ``core.resilience.CircuitBreaker``; an open circuit routes requests to
   the fallback rung without burning a failure per request, and a
   half-open probe restores the rung when it heals.
-- **graceful degradation**: when queue depth (or latency p99) crosses
-  its threshold the scheduler switches to the degraded rung ladder and
-  coarser (power-of-two-padded) shape buckets, and wraps batch execution
-  in a ``degraded-mode`` span — the trade shows up in ``trace summary``,
-  not just in the latency distribution.  Exit has hysteresis (half the
-  entry depth) so the mode doesn't flap.
+- **graceful degradation**: when the SLO monitor burns (``serve/slo.py``
+  — the primary trigger when one is attached) or queue depth / latency
+  p99 crosses its threshold (the backstops), the scheduler switches to
+  the degraded rung ladder and coarser (power-of-two-padded) shape
+  buckets, and wraps batch execution in a ``degraded-mode`` span — the
+  trade shows up in ``trace summary``, not just in the latency
+  distribution.  Exit has hysteresis (half the entry depth; the SLO
+  monitor's own recovery hysteresis) so the mode doesn't flap.
+- **request-lifecycle tracing**: every request is phase-stamped on the
+  server clock (submit → dequeue → admit → execute → complete); results
+  carry the ``timing`` breakdown, a ``request-served`` event links each
+  rid to the ``serve.batch`` span that executed it, and the phases feed
+  ``serve.request.<phase>_ms`` histograms plus per-tenant
+  ``serve.tenant.<t>.*`` counters.
 - **admission**: with a memory budget set (``CME213_MEMORY_BUDGET``),
   batch sizes are preflighted (``core.admission.admit_batch``) and
   shrink before dispatch; overflow requests stay queued, and a shape
@@ -49,7 +57,7 @@ from ..core import admission, metrics
 from ..core.errors import FrameworkError
 from ..core.faults import maybe_slow
 from ..core.resilience import CircuitBreaker, Clock, with_fallback
-from ..core.trace import record_event, span
+from ..core.trace import current_span_id, record_event, span
 from .request import (
     ADMISSION,
     DEADLINE,
@@ -106,7 +114,8 @@ class Server:
                  breaker_cooldown_s: float = 30.0,
                  degrade_depth: int | None = None,
                  degrade_p99_ms: float | None = None,
-                 adapters: dict | None = None):
+                 adapters: dict | None = None,
+                 slo=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.clock = clock if clock is not None else Clock()
@@ -120,38 +129,59 @@ class Server:
         self.degraded = False
         self._degrade_reason: str | None = None
         self.adapters = adapters if adapters is not None else dict(ADAPTERS)
+        self.slo = slo                  # serve.slo.SLOMonitor | None
         self._rids = itertools.count()
         self._admit_cache: dict[tuple, int] = {}
 
     # ------------------------------------------------------------ submit
 
-    def submit(self, op: str, payload, deadline_ms: float | None = None):
+    def submit(self, op: str, payload, deadline_ms: float | None = None,
+               tenant: str = "default"):
         """Accept (returns the request id) or refuse (returns a SHED
         :class:`SolveResult`) — never blocks, never queues unboundedly."""
         if op not in self.adapters:
             raise ValueError(f"unknown op {op!r} "
                              f"(serving: {sorted(self.adapters)})")
         metrics.counter("serve.requests").inc()
+        metrics.counter(f"serve.tenant.{tenant}.requests").inc()
         now = self.clock.now()
         rid = next(self._rids)
         if deadline_ms is not None and deadline_ms <= 0:
             return self._shed_deadline(
-                SolveRequest(rid, op, payload, now, now), late_ms=-deadline_ms)
+                SolveRequest(rid, op, payload, now, now, tenant=tenant),
+                late_ms=-deadline_ms, now=now)
         req = SolveRequest(
             rid, op, payload, submitted_s=now,
-            deadline_s=None if deadline_ms is None else now + deadline_ms / 1e3)
+            deadline_s=None if deadline_ms is None else now + deadline_ms / 1e3,
+            tenant=tenant)
         if not self.queue.push(req):
             metrics.counter(f"serve.shed.{QUEUE_FULL}").inc()
+            metrics.counter(f"serve.tenant.{tenant}.shed").inc()
             record_event("queue-shed", op=op, reason=QUEUE_FULL,
-                         depth=len(self.queue))
-            return SolveResult(rid, op, SHED, reason=QUEUE_FULL)
+                         depth=len(self.queue), age_ms=0.0, tenant=tenant)
+            res = SolveResult(rid, op, SHED, reason=QUEUE_FULL, tenant=tenant,
+                              timing=req.timing())
+            self._observe_slo(res)
+            return res
         return rid
 
-    def _shed_deadline(self, req: SolveRequest, late_ms: float) -> SolveResult:
+    def _shed_deadline(self, req: SolveRequest, late_ms: float,
+                       now: float | None = None) -> SolveResult:
+        now = self.clock.now() if now is None else now
         metrics.counter(f"serve.shed.{DEADLINE}").inc()
+        metrics.counter(f"serve.tenant.{req.tenant}.shed").inc()
         record_event("deadline-shed", op=req.op, rid=req.rid,
-                     late_ms=round(late_ms, 3))
-        return SolveResult(req.rid, req.op, SHED, reason=DEADLINE)
+                     late_ms=round(late_ms, 3), depth=len(self.queue),
+                     age_ms=round((now - req.submitted_s) * 1e3, 3),
+                     tenant=req.tenant)
+        res = SolveResult(req.rid, req.op, SHED, reason=DEADLINE,
+                          tenant=req.tenant, timing=req.timing())
+        self._observe_slo(res)
+        return res
+
+    def _observe_slo(self, result: SolveResult) -> None:
+        if self.slo is not None:
+            self.slo.observe_result(result)
 
     # -------------------------------------------------------------- step
 
@@ -167,7 +197,8 @@ class Server:
         if expired:
             self.queue.take(expired)
             results.extend(
-                self._shed_deadline(r, late_ms=(now - r.deadline_s) * 1e3)
+                self._shed_deadline(r, late_ms=(now - r.deadline_s) * 1e3,
+                                    now=now)
                 for r in expired)
 
         self._update_degraded()
@@ -183,10 +214,16 @@ class Server:
                  and adapter.shape_class(r.payload, coarse=coarse) == key]
         batch = batch[:self.max_batch]
 
+        dequeued = self.clock.now()
+        for r in batch:
+            r.dequeued_s = dequeued
         batch, admission_shed = self._admit(adapter, key, batch, coarse)
         results.extend(admission_shed)
         if not batch:
             return results
+        admitted = self.clock.now()
+        for r in batch:
+            r.admitted_s = admitted
         self.queue.take(batch)
         results.extend(self._execute(adapter, key, batch, coarse))
         return results
@@ -219,13 +256,19 @@ class Server:
                     f"serve.{adapter.op}", len(batch), builder)
             except admission.AdmissionError:
                 self.queue.take(batch)
+                now = self.clock.now()
                 shed = []
                 for r in batch:
                     metrics.counter(f"serve.shed.{ADMISSION}").inc()
+                    metrics.counter(f"serve.tenant.{r.tenant}.shed").inc()
                     record_event("queue-shed", op=r.op, reason=ADMISSION,
-                                 depth=len(self.queue))
-                    shed.append(SolveResult(r.rid, r.op, SHED,
-                                            reason=ADMISSION))
+                                 depth=len(self.queue),
+                                 age_ms=round((now - r.submitted_s) * 1e3, 3),
+                                 tenant=r.tenant)
+                    res = SolveResult(r.rid, r.op, SHED, reason=ADMISSION,
+                                      tenant=r.tenant, timing=r.timing())
+                    self._observe_slo(res)
+                    shed.append(res)
                 return [], shed
             self._admit_cache[cache_key] = admitted
         return batch[:admitted], []
@@ -238,22 +281,42 @@ class Server:
                    (lambda rg: lambda: adapter.run_batch(
                        payloads, rg, coarse=coarse))(rung))
                   for rung in rungs]
-        # injected straggler latency rides the server clock, so it shows
-        # up in latencies and subsequent deadline decisions exactly like
-        # a real slow device
-        maybe_slow(f"serve.{op}", sleep=self.clock.sleep)
         ctx = (span("degraded-mode", op=op,
                     reason=self._degrade_reason or "pressure")
                if self.degraded else nullcontext())
+        # the run phase starts here: injected straggler latency rides the
+        # server clock, so it shows up in run_ms, latencies, and
+        # subsequent deadline decisions exactly like a real slow device
+        executed = self.clock.now()
+        for r in batch:
+            r.executed_s = executed
         try:
-            with ctx:
+            with ctx, span("serve.batch", op=op, shape_class=key,
+                           size=len(batch)):
+                batch_span = current_span_id()
+                maybe_slow(f"serve.{op}", sleep=self.clock.sleep)
                 res = with_fallback(f"serve.{op}", ladder,
                                     breaker=self.breaker)
         except FrameworkError as e:
+            end = self.clock.now()
             metrics.counter("serve.failed").inc(len(batch))
-            return [SolveResult(r.rid, op, FAILED, reason=str(e)[:200],
-                                shape_class=key, batch_size=len(batch),
-                                degraded=self.degraded) for r in batch]
+            out = []
+            for r in batch:
+                r.completed_s = end
+                metrics.counter(f"serve.tenant.{r.tenant}.failed").inc()
+                timing = r.timing()
+                record_event("request-served", rid=r.rid, op=op,
+                             tenant=r.tenant, batch=batch_span,
+                             status=FAILED, total_ms=timing["total_ms"],
+                             **{k: v for k, v in timing.items()
+                                if k != "total_ms"})
+                res_f = SolveResult(
+                    r.rid, op, FAILED, reason=str(e)[:200], shape_class=key,
+                    batch_size=len(batch), degraded=self.degraded,
+                    tenant=r.tenant, timing=timing)
+                self._observe_slo(res_f)
+                out.append(res_f)
+            return out
         end = self.clock.now()
         occupancy = len(batch) / self.max_batch
         metrics.counter("serve.batches").inc()
@@ -262,20 +325,41 @@ class Server:
                      size=len(batch), occupancy=round(occupancy, 4))
         out = []
         for r, value in zip(batch, res.value):
+            r.completed_s = end
             latency_ms = (end - r.submitted_s) * 1e3
             metrics.histogram("serve.latency.ms").observe(latency_ms)
             metrics.histogram(f"serve.latency.{op}.ms").observe(latency_ms)
-            out.append(SolveResult(
+            metrics.counter(f"serve.tenant.{r.tenant}.served").inc()
+            timing = r.timing()
+            for phase in ("queue", "admit", "batch_wait", "run", "total"):
+                v = timing[f"{phase}_ms"]
+                if v is not None:
+                    metrics.histogram(f"serve.request.{phase}_ms").observe(v)
+            record_event("request-served", rid=r.rid, op=op, tenant=r.tenant,
+                         batch=batch_span, status=OK,
+                         total_ms=timing["total_ms"],
+                         **{k: v for k, v in timing.items()
+                            if k != "total_ms"})
+            res_ok = SolveResult(
                 r.rid, op, OK, value=value, rung=res.rung, shape_class=key,
                 latency_ms=latency_ms, batch_size=len(batch),
-                degraded=self.degraded))
+                degraded=self.degraded, tenant=r.tenant, timing=timing)
+            self._observe_slo(res_ok)
+            out.append(res_ok)
+        metrics.write_exposition()   # no-op unless CME213_METRICS_FILE set
         return out
 
     def _update_degraded(self) -> None:
+        if self.slo is not None:
+            self.slo.evaluate()
         depth = len(self.queue)
         p99 = metrics.histogram("serve.latency.ms").percentile(0.99)
         reason = None
-        if self.degrade_depth is not None and depth >= self.degrade_depth:
+        # objective violation is the primary trigger; raw queue depth and
+        # the latency ring are the backstops for servers without an SLO
+        if self.slo is not None and self.slo.burning:
+            reason = "slo-burn"
+        elif self.degrade_depth is not None and depth >= self.degrade_depth:
             reason = "queue-depth"
         elif (self.degrade_p99_ms is not None and p99 is not None
               and p99 >= self.degrade_p99_ms):

@@ -78,7 +78,9 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
-    from ..core import programs
+    from ..core import flight, programs
+
+    flight.install()
     from .loadgen import compile_attribution
 
     cache_dir = os.environ.get("CME213_COMPILE_CACHE")

@@ -29,6 +29,12 @@ templating).  Three commands:
   under the trace umbrella): fresh sweep CSVs + ``metrics.json`` vs a
   banked baseline directory, machine-readable verdict, nonzero exit
   under ``--strict``.
+- ``metrics``  — render a metrics snapshot in the Prometheus text
+  exposition format (``core/metrics.render_prometheus``).  Accepts a
+  trace JSONL file (uses its last ``metrics-snapshot`` event), a
+  snapshot JSON document, or a flight dump.
+- ``flight``   — render a crash flight dump (``core/flight.py``):
+  header, traceback, open spans, and the pre-crash event timeline.
 
 Any unparseable line is a hard error (exit 2): a trace that cannot be
 trusted end-to-end must fail the smoke gate, not be silently skipped.
@@ -45,6 +51,7 @@ import os
 import sys
 from collections import Counter, defaultdict
 
+from .core.metrics import _nearest_rank
 from .core.trace import validate_record
 
 
@@ -90,7 +97,7 @@ def _percentiles(vals: list[float]) -> dict:
     vals = sorted(vals)
 
     def pct(q):
-        return vals[min(len(vals) - 1, max(0, round(q * (len(vals) - 1))))]
+        return _nearest_rank(vals, q)
 
     return {"p50": pct(0.50), "p90": pct(0.90), "p99": pct(0.99),
             "max": vals[-1]}
@@ -308,18 +315,25 @@ def summarize(events: list[dict], out=None) -> dict:
     batches = [e for e in events if e["event"] == "batch-executed"]
     degraded = sum(1 for e in events if e["event"] == "span-end"
                    and e.get("span") == "degraded-mode")
+    reqs = [e for e in events if e["event"] == "request-served"]
     serving = None
-    if shed or any(breaker.values()) or batches:
+    if shed or any(breaker.values()) or batches or reqs:
         occ = [e["occupancy"] for e in batches
                if isinstance(e.get("occupancy"), (int, float))]
         sizes = [e["size"] for e in batches
                  if isinstance(e.get("size"), (int, float))]
+        # stable shed keys: every serving op appears with every shed
+        # reason, zero-filled, so downstream diffs never see keys
+        # flicker in and out with the traffic
+        serve_ops = sorted({str(e.get("op")) for e in
+                            (batches + reqs)} |
+                           {str(op) for op, _ in shed})
+        shed_keys = {f"{op}:{reason}": 0 for op in serve_ops
+                     for reason in ("queue-full", "deadline", "admission")}
+        for (op, reason), n in shed.items():
+            shed_keys[f"{op}:{reason}"] = n
         serving = {
-            "shed": {f"{op}:{reason}": n
-                     for (op, reason), n in sorted(shed.items(),
-                                                   key=lambda kv: (
-                                                       str(kv[0][0]),
-                                                       str(kv[0][1])))},
+            "shed": dict(sorted(shed_keys.items())),
             "breaker": {k: [f"{op}.{rung}" for op, rung in v]
                         for k, v in breaker.items()},
             "batches": len(batches),
@@ -335,11 +349,97 @@ def summarize(events: list[dict], out=None) -> dict:
             w(f", {degraded} degraded")
         w("\n")
         for key, n in serving["shed"].items():
-            w(f"  shed {key} x{n}\n")
+            if n:
+                w(f"  shed {key} x{n}\n")
         for transition in ("open", "half_open", "close"):
             for target in breaker[transition]:
                 w(f"  breaker {transition.replace('_', '-')}: "
                   f"{target[0]}.{target[1]}\n")
+
+    # request-lifecycle phase attribution: request-served events carry
+    # the per-phase timing breakdown stamped by the server clock
+    phases = None
+    if reqs:
+        per_op: dict[str, dict[str, list]] = defaultdict(
+            lambda: defaultdict(list))
+        for e in reqs:
+            op = str(e.get("op"))
+            for ph in ("queue_ms", "admit_ms", "batch_wait_ms", "run_ms",
+                       "total_ms"):
+                v = e.get(ph)
+                if isinstance(v, (int, float)):
+                    per_op[op][ph].append(v)
+                    per_op["overall"][ph].append(v)
+        phases = {}
+        for op, cols in per_op.items():
+            phases[op] = {ph: {"p50": round(_nearest_rank(sorted(vs), 0.5), 3),
+                               "p99": round(_nearest_rank(sorted(vs), 0.99), 3)}
+                          for ph, vs in cols.items() if vs}
+        w(f"request phases (p50/p99 ms over {len(reqs)} request(s)):\n")
+        for op in sorted(phases, key=lambda o: (o != "overall", o)):
+            cells = "  ".join(
+                f"{ph[:-3]} {d['p50']}/{d['p99']}"
+                for ph, d in sorted(phases[op].items(), key=lambda kv: (
+                    ("queue_ms", "admit_ms", "batch_wait_ms", "run_ms",
+                     "total_ms").index(kv[0]))))
+            w(f"  {op}: {cells}\n")
+
+    # per-tenant accounting: request-served carries tenant; shed events
+    # carry it as an optional tag
+    tenants = None
+    tenant_rows: dict[str, dict] = defaultdict(
+        lambda: {"served": 0, "failed": 0, "shed": 0, "_lat": []})
+    for e in reqs:
+        row = tenant_rows[str(e.get("tenant"))]
+        if e.get("status") == "ok":
+            row["served"] += 1
+            if isinstance(e.get("total_ms"), (int, float)):
+                row["_lat"].append(e["total_ms"])
+        else:
+            row["failed"] += 1
+    for e in events:
+        if e["event"] in ("queue-shed", "deadline-shed") and "tenant" in e:
+            tenant_rows[str(e.get("tenant"))]["shed"] += 1
+    if tenant_rows:
+        tenants = {}
+        for t, row in sorted(tenant_rows.items()):
+            lat = sorted(row.pop("_lat"))
+            tenants[t] = {**row,
+                          "p50_ms": (round(_nearest_rank(lat, 0.5), 3)
+                                     if lat else None),
+                          "p99_ms": (round(_nearest_rank(lat, 0.99), 3)
+                                     if lat else None)}
+        w("tenants:\n")
+        for t, row in tenants.items():
+            tail = (f", p50 {row['p50_ms']} p99 {row['p99_ms']} ms"
+                    if row["p50_ms"] is not None else "")
+            w(f"  {t}: {row['served']} served, {row['shed']} shed, "
+              f"{row['failed']} failed{tail}\n")
+
+    # SLO burn/recovery transitions (serve/slo.py)
+    slo = None
+    burns = [e for e in events if e["event"] == "slo-burn"]
+    oks = [e for e in events if e["event"] == "slo-ok"]
+    if burns or oks:
+        slo = {
+            "burns": len(burns),
+            "oks": len(oks),
+            "objectives": sorted({str(e.get("objective"))
+                                  for e in burns + oks}),
+            "last_burn": (
+                {"objective": burns[-1].get("objective"),
+                 "burn_short": burns[-1].get("burn_short"),
+                 "burn_long": burns[-1].get("burn_long"),
+                 "threshold": burns[-1].get("threshold")}
+                if burns else None),
+        }
+        w(f"slo: {len(burns)} burn(s), {len(oks)} recover(ies) "
+          f"[{', '.join(slo['objectives'])}]\n")
+        for e in burns:
+            w(f"  burn {e.get('objective')}: short {e.get('burn_short')} "
+              f"long {e.get('burn_long')} >= {e.get('threshold')}\n")
+        for e in oks:
+            w(f"  ok {e.get('objective')}: short {e.get('burn_short')}\n")
 
     counts = Counter(e["event"] for e in events)
     for label, ev in (("op failures", "op-failure"),
@@ -382,6 +482,9 @@ def summarize(events: list[dict], out=None) -> dict:
                             for (op, rung, ok), n in conf.items()},
             "admission": {"rejected": len(rejected), "shrunk": len(shrunk)},
             "serving": serving,
+            "phases": phases,
+            "tenants": tenants,
+            "slo": slo,
             "counts": dict(counts)}
 
 
@@ -522,6 +625,76 @@ def to_chrome_trace(events: list[dict]) -> dict:
     return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
 
 
+# ------------------------------------------------------------------ flight
+
+def load_metrics_snapshot(path: str) -> dict:
+    """A metrics snapshot from any of the formats that carry one: a
+    snapshot JSON document, a flight dump (its ``metrics`` key), or a
+    trace JSONL file (the last ``metrics-snapshot`` event).  Raises
+    TraceParseError when none is found."""
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if head == "{":
+            try:
+                doc = json.load(f)
+            except ValueError:
+                doc = None
+            if isinstance(doc, dict):
+                if "counters" in doc or "histograms" in doc:
+                    return doc
+                if isinstance(doc.get("metrics"), dict):
+                    return doc["metrics"]
+    snaps = [e for e in load_events([path]) if e["event"] == "metrics-snapshot"]
+    if not snaps:
+        raise TraceParseError(f"{path}: no metrics snapshot found")
+    return snaps[-1].get("metrics", {})
+
+
+def load_flight(path: str) -> dict:
+    """Parse a flight dump; TraceParseError when it isn't one."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except ValueError as e:
+        raise TraceParseError(f"{path}: {e}") from e
+    if not isinstance(doc, dict) or "reason" not in doc or \
+            not isinstance(doc.get("events"), list):
+        raise TraceParseError(f"{path}: not a flight dump")
+    return doc
+
+
+def render_flight(doc: dict, out=None) -> None:
+    """Human rendering of a flight dump: header, traceback, open spans,
+    the pre-crash timeline, and a metrics digest."""
+    out = out or sys.stdout
+    w = out.write
+    plat = doc.get("platform") or {}
+    w(f"flight dump: reason {doc.get('reason')!r}, pid {doc.get('pid')}, "
+      f"rank {doc.get('rank')}, incarnation {doc.get('incarnation')}\n")
+    w(f"  platform: python {plat.get('python')}, jax {plat.get('jax')}, "
+      f"{plat.get('platform')}\n")
+    if plat.get("argv"):
+        w(f"  argv: {' '.join(str(a) for a in plat['argv'])}\n")
+    if doc.get("traceback"):
+        w("traceback:\n")
+        for line in str(doc["traceback"]).rstrip().split("\n"):
+            w(f"  {line}\n")
+    open_spans = doc.get("open_spans") or []
+    if open_spans:
+        w(f"open spans at death ({len(open_spans)}):\n")
+        for s in open_spans:
+            w(f"  {s.get('span')} (id {s.get('id')}, "
+              f"parent {s.get('parent')})\n")
+    events = doc.get("events") or []
+    w(f"last {len(events)} event(s) before death:\n")
+    render_timeline(events, out=out)
+    m = doc.get("metrics") or {}
+    w(f"metrics at death: {len(m.get('counters', {}))} counters, "
+      f"{len(m.get('gauges', {}))} gauges, "
+      f"{len(m.get('histograms', {}))} histograms\n")
+
+
 # -------------------------------------------------------------------- main
 
 def main(argv: list[str] | None = None) -> int:
@@ -567,6 +740,15 @@ def main(argv: list[str] | None = None) -> int:
     p_rg.add_argument("args", nargs=argparse.REMAINDER,
                       help="arguments forwarded to bench.regress")
 
+    p_mt = sub.add_parser("metrics", help="Prometheus text exposition of "
+                                          "a metrics snapshot")
+    p_mt.add_argument("file",
+                      help="trace JSONL (last metrics-snapshot event), "
+                           "snapshot JSON, or flight dump")
+
+    p_fl = sub.add_parser("flight", help="render a crash flight dump")
+    p_fl.add_argument("file", help="flight-<pid>-<ts>.json dump")
+
     # intercepted before argparse: REMAINDER won't swallow leading flags
     # (``trace regress --fresh ...``), and regress owns its own CLI
     if argv is None:
@@ -577,6 +759,23 @@ def main(argv: list[str] | None = None) -> int:
         return regress_main(list(argv[1:]))
 
     args = ap.parse_args(argv)
+    if args.cmd == "metrics":
+        from .core.metrics import render_prometheus
+        try:
+            snap = load_metrics_snapshot(args.file)
+        except (TraceParseError, OSError) as e:
+            print(f"trace: {e}", file=sys.stderr)
+            return 2
+        sys.stdout.write(render_prometheus(snap))
+        return 0
+    if args.cmd == "flight":
+        try:
+            doc = load_flight(args.file)
+        except (TraceParseError, OSError) as e:
+            print(f"trace: {e}", file=sys.stderr)
+            return 2
+        render_flight(doc)
+        return 0
     try:
         events = load_events(args.files)
     except (TraceParseError, OSError) as e:

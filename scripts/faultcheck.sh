@@ -41,6 +41,11 @@
 #     accounting exact), and a fail:-poisoned kernel rung opens its
 #     circuit breaker while the fallback rung keeps serving — both
 #     verified from the SLO report AND via `trace summary --require`.
+# And per ISSUE 10 (observability):
+# 10. flight recorder: a serve run that dies on an unhandled exception
+#     after serving traffic leaves a parseable flight-*.json black box
+#     (reason, traceback, pre-crash events, metrics at death) that
+#     `trace flight` renders.
 # On ANY failing step the merged gang timeline is printed for
 # debuggability before the workspace is cleaned up.
 set -euo pipefail
@@ -61,7 +66,7 @@ on_exit() {
 }
 trap on_exit EXIT
 
-echo "== 1/9 run_all: injected sweep failure -> retry + failures.json"
+echo "== 1/10 run_all: injected sweep failure -> retry + failures.json"
 CME213_FAULTS="fail:sweep.scan_bandwidth" \
     python -m cme213_tpu.bench.run_all --quick --out "$OUT" \
     --only scan_bandwidth
@@ -73,7 +78,7 @@ assert [r["sweep"] for r in m["retried"]] == ["scan_bandwidth"], m
 print("failures.json populated:", m["retried"][0]["error"])
 PY
 
-echo "== 2/9 spmv ladder: injected pallas failure -> demoted, correct"
+echo "== 2/10 spmv ladder: injected pallas failure -> demoted, correct"
 CME213_FAULTS="fail:spmv_scan.pallas-fused" python - <<'PY'
 from cme213_tpu.apps import spmv_scan as sp
 from cme213_tpu.core import trace
@@ -86,7 +91,7 @@ assert errs["rel_l2"] < 1e-4, errs
 print("demoted to", served["rung"], "rel_l2", errs["rel_l2"])
 PY
 
-echo "== 3/9 launcher: injected rank kill survived by --max-restarts 1"
+echo "== 3/10 launcher: injected rank kill survived by --max-restarts 1"
 CME213_FAULTS="rankkill:1:0" python -m cme213_tpu.dist.launch \
     --np 2 --max-restarts 1 --timeout 120 -- \
     python -c "import os; from cme213_tpu.core import faults; \
@@ -111,7 +116,7 @@ cat > "$OUT/params_gang.in" <<'EOF'
 100.0 25.0 0.0 50.0
 EOF
 
-echo "== 4/9 supervised gang: rankkill -> gang restart + epoch-commit resume"
+echo "== 4/10 supervised gang: rankkill -> gang restart + epoch-commit resume"
 # 1 process x 2 fake devices: real halo-exchange collectives in the rank,
 # real process death, real gang supervision — works on every backend.
 # Per-rank trace sinks feed step 6's CLI gate.
@@ -133,7 +138,7 @@ print(f"gang recovery OK (final commit: epoch {m['epoch']}, "
       f"step {m['step']})")
 PY
 
-echo "== 5/9 supervised gang across 2 REAL ranks (capability-gated)"
+echo "== 5/10 supervised gang across 2 REAL ranks (capability-gated)"
 set +e
 CME213_FAULTS="rankkill:1:1" JAX_PLATFORMS= \
 CME213_TRACE_FILE="$OUT/trace5-{rank}.jsonl" python -m cme213_tpu.dist.launch \
@@ -161,7 +166,7 @@ else
   echo "2-rank gang recovery OK"
 fi
 
-echo "== 6/9 trace CLI over the per-rank gang traces (ISSUE 4)"
+echo "== 6/10 trace CLI over the per-rank gang traces (ISSUE 4)"
 # step 4's files always exist; any unparseable line exits 2, a missing
 # commit span or gang phase exits 1 — either fails the gate
 python -m cme213_tpu trace summary "$OUT"/trace4-*.jsonl \
@@ -182,7 +187,7 @@ if ls "$OUT"/trace5-*.jsonl >/dev/null 2>&1; then
       > /dev/null
 fi
 
-echo "== 7/9 conformance gate: wrong: probe poison -> demotion (ISSUE 5)"
+echo "== 7/10 conformance gate: wrong: probe poison -> demotion (ISSUE 5)"
 # the first conformance probe of spmv_scan (the requested pallas-fused
 # rung) is perturbed; the gate must demote it, the next rung (blocked,
 # probe call 2, clean) serves, and the result still passes the f64 check
@@ -211,7 +216,7 @@ if python -m cme213_tpu trace summary "$OUT/trace7.jsonl" \
   exit 1
 fi
 
-echo "== 8/9 admission: oom: -> chunk shrink, bitwise-equal completion"
+echo "== 8/10 admission: oom: -> chunk shrink, bitwise-equal completion"
 CME213_FAULTS="oom:heat_chunk:1" \
 CME213_TRACE_FILE="$OUT/trace8.jsonl" python - "$OUT" <<'PY'
 import os
@@ -233,7 +238,7 @@ PY
 python -m cme213_tpu trace summary "$OUT/trace8.jsonl" \
     --require chunk-shrunk
 
-echo "== 9/9 serving: open-loop burst over a tiny queue sheds + breaker opens"
+echo "== 9/10 serving: open-loop burst over a tiny queue sheds + breaker opens"
 # 24 cipher requests burst at a 6-deep queue: backpressure MUST shed the
 # excess with structured queue-shed events, and the fail:-poisoned packed
 # rung MUST open its circuit (3 classified failures) while the bytes rung
@@ -257,5 +262,47 @@ print(f"overload shed {rep['shed']}/{rep['requests']}, served "
 PY
 python -m cme213_tpu trace summary "$OUT/trace9.jsonl" \
     --require queue-shed,breaker-open
+
+echo "== 10/10 flight recorder: a crashing serve run leaves its black box"
+# serve real traffic first (the dump must have a history worth reading),
+# then die on an unhandled exception: the armed recorder writes the
+# flight dump on the way down — reason, traceback, the pre-crash event
+# ring, and the metrics registry at death, all in one parseable file
+mkdir -p "$OUT/flight"
+set +e
+CME213_FLIGHT_DIR="$OUT/flight" python - > "$OUT/flight.log" 2>&1 <<'PY'
+from cme213_tpu.core import flight
+flight.install()
+from cme213_tpu.serve import OK, Server
+from cme213_tpu.serve.loadgen import build_mix, run_load
+run = run_load(Server(max_batch=2), build_mix("cipher", 6, seed=0),
+               mode="closed", concurrency=3)
+assert all(r.status == OK for r in run["results"])
+raise RuntimeError("injected serve crash after 6 served")
+PY
+rc=$?
+set -e
+if [ "$rc" -eq 0 ]; then
+  echo "ERROR: crashing serve run exited 0" >&2
+  exit 1
+fi
+grep -q "injected serve crash" "$OUT/flight.log"   # chained hook printed
+DUMP=$(ls "$OUT"/flight/flight-*.json)
+python - "$DUMP" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc.get("flight") == 1, sorted(doc)
+assert doc["reason"] == "unhandled-exception", doc["reason"]
+assert "injected serve crash" in doc["traceback"], doc["traceback"]
+assert doc["metrics"]["counters"]["serve.batches"] >= 1, doc["metrics"]
+assert any(e["event"] == "request-served" for e in doc["events"]), \
+    "no pre-crash serve history in the dump"
+print(f"flight dump OK: {len(doc['events'])} pre-crash events captured")
+PY
+# render to a file, not a pipe: `grep -q` closing the pipe early would
+# kill the renderer with SIGPIPE under pipefail
+python -m cme213_tpu trace flight "$DUMP" > "$OUT/flight-render.txt"
+grep -q "reason 'unhandled-exception'" "$OUT/flight-render.txt"
+grep -q "injected serve crash" "$OUT/flight-render.txt"
 
 echo "faultcheck OK"

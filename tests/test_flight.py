@@ -1,0 +1,190 @@
+"""Crash flight recorder (``core/flight.py``): explicit dumps, the
+crash paths (unhandled exception, fatal signal, ``rankkill`` hard-exit —
+including inside a supervised 2-rank gang), dump-file atomicity, and the
+``trace flight`` rendering."""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from cme213_tpu.core import faults, flight, metrics, trace
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    monkeypatch.delenv(flight.FLIGHT_DIR_ENV, raising=False)
+    # earlier suite members may have run a CLI main() that installs the
+    # recorder (loadgen does); start from the uninstalled state
+    flight._uninstall_for_tests()
+    trace.clear_events()
+    metrics.reset()
+    yield
+    flight._uninstall_for_tests()
+    faults.reset()
+    metrics.reset()
+
+
+def _dumps(d):
+    return sorted(glob.glob(os.path.join(str(d), "flight-*.json")))
+
+
+def _run(body, tmp_path, **env):
+    """Run a python -c body with the flight dir pointed at tmp_path."""
+    full = dict(os.environ)
+    full.pop("CME213_FAULTS", None)
+    full.pop("CME213_INCARNATION", None)
+    full.update({flight.FLIGHT_DIR_ENV: str(tmp_path)}, **env)
+    return subprocess.run(
+        [sys.executable, "-c", f"import sys; sys.path.insert(0, {_REPO!r})\n"
+         + body],
+        env=full, capture_output=True, text=True, timeout=60)
+
+
+# ------------------------------------------------------------ dump basics
+
+def test_dump_unarmed_is_noop(tmp_path):
+    assert not flight.installed()
+    assert flight.dump("nothing-listening") is None
+    assert _dumps(tmp_path) == []
+
+
+def test_explicit_dump_contents(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(tmp_path))
+    metrics.counter("faults.fail").inc(3)
+    with trace.span("heat.run", shape_class="32x32"):
+        path = flight.dump("operator-requested")   # mid-span: span open
+    assert path and os.path.dirname(path) == str(tmp_path)
+    doc = json.loads(open(path).read())
+    assert doc["flight"] == 1
+    assert doc["reason"] == "operator-requested"
+    assert doc["pid"] == os.getpid()
+    assert doc["platform"]["python"] == sys.version.split()[0]
+    assert doc["traceback"] is None
+    assert doc["metrics"]["counters"]["faults.fail"] == 3
+    assert [s["span"] for s in doc["open_spans"]] == ["heat.run"]
+    assert any(e["event"] == "span-begin" for e in doc["events"])
+    # the dump records itself in the trace ring
+    (ev,) = trace.events("flight-dump")
+    assert ev["reason"] == "operator-requested" and ev["path"] == path
+
+
+def test_dump_is_atomic_no_tmp_leftovers(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(tmp_path))
+    for i in range(3):
+        metrics.counter("x").inc()
+        assert flight.dump(f"r{i}")
+    paths = _dumps(tmp_path)
+    assert len(paths) == 3                       # unique names, no clobber
+    for p in paths:
+        json.loads(open(p).read())               # every file parses whole
+    assert glob.glob(os.path.join(str(tmp_path), "*.tmp*")) == []
+
+
+def test_dump_with_exception_carries_traceback(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(tmp_path))
+    try:
+        raise ValueError("poisoned state at step 7")
+    except ValueError as e:
+        path = flight.dump("numeric-abort", exc=e)
+    doc = json.loads(open(path).read())
+    assert "poisoned state at step 7" in doc["traceback"]
+    assert "ValueError" in doc["traceback"]
+
+
+# ------------------------------------------------------------ crash paths
+
+def test_unhandled_exception_dumps_before_death(tmp_path):
+    proc = _run(
+        "from cme213_tpu.core import flight\n"
+        "flight.install()\n"
+        "raise RuntimeError('solver blew up')\n", tmp_path)
+    assert proc.returncode == 1
+    assert "solver blew up" in proc.stderr       # chained hook still prints
+    (path,) = _dumps(tmp_path)
+    doc = json.loads(open(path).read())
+    assert doc["reason"] == "unhandled-exception"
+    assert "solver blew up" in doc["traceback"]
+
+
+def test_rankkill_hard_exit_dumps(tmp_path):
+    """``os._exit`` bypasses atexit and the excepthook — the kill guard
+    dumps inline, so even the hard-exit path leaves a black box."""
+    proc = _run(
+        "from cme213_tpu.core import faults\n"
+        "faults.maybe_kill_rank(step=0)\n", tmp_path,
+        CME213_FAULTS="rankkill:0:0", JAX_PROCESS_ID="0")
+    assert proc.returncode == faults.KILL_EXIT
+    (path,) = _dumps(tmp_path)
+    doc = json.loads(open(path).read())
+    assert doc["reason"] == "rankkill"
+    assert doc["rank"] == "0" and doc["incarnation"] == "0"
+    assert doc["metrics"]["counters"]["faults.rankkill"] == 1
+    assert any(e["event"] == "fault-injected" for e in doc["events"])
+
+
+def test_fatal_signal_dumps_then_dies_by_signal(tmp_path):
+    proc = _run(
+        "import os, signal\n"
+        "from cme213_tpu.core import flight\n"
+        "flight.install()\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n", tmp_path)
+    assert proc.returncode == -signal.SIGTERM    # signal semantics kept
+    (path,) = _dumps(tmp_path)
+    assert json.loads(open(path).read())["reason"] == "signal:SIGTERM"
+
+
+def test_supervised_gang_rankkill_leaves_per_rank_dump(tmp_path,
+                                                       monkeypatch, capsys):
+    """A rank hard-killed inside a supervised gang leaves a parseable
+    flight dump behind while the gang restarts and completes."""
+    from cme213_tpu.dist.launch import launch_supervised
+
+    monkeypatch.setenv("CME213_FAULTS", "rankkill:1:0")
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(tmp_path))
+    body = (f"import sys; sys.path.insert(0, {_REPO!r}); import os; "
+            "from cme213_tpu.core import faults; faults.maybe_kill_rank(); "
+            "print('rank', os.environ['JAX_PROCESS_ID'], 'ok')")
+    rc = launch_supervised(2, [sys.executable, "-c", body],
+                           stall_timeout=60, max_restarts=1, timeout=120)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    (path,) = _dumps(tmp_path)                   # the killed rank's box
+    doc = json.loads(open(path).read())
+    assert doc["reason"] == "rankkill"
+    assert doc["rank"] == "1" and doc["incarnation"] == "0"
+
+
+# --------------------------------------------------------------- rendering
+
+def test_trace_flight_renders_dump(tmp_path, monkeypatch, capsys):
+    from cme213_tpu.trace_cli import main as trace_main
+
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(tmp_path))
+    metrics.counter("serve.batches").inc(2)
+    with trace.span("serve.batch", op="echo", shape_class="k", size=2):
+        try:
+            raise RuntimeError("ladder exhausted")
+        except RuntimeError as e:
+            path = flight.dump("serve-crash", exc=e)
+    assert trace_main(["flight", path]) == 0
+    out = capsys.readouterr().out
+    assert "flight dump: reason 'serve-crash'" in out
+    assert "ladder exhausted" in out
+    assert "serve.batch" in out                  # open span + timeline
+    assert "metrics at death: 1 counters" in out
+
+
+def test_trace_flight_rejects_non_dump(tmp_path, capsys):
+    from cme213_tpu.trace_cli import main as trace_main
+
+    bad = tmp_path / "not-a-dump.json"
+    bad.write_text('{"counters": {}}')
+    assert trace_main(["flight", str(bad)]) == 2
+    assert "not a flight dump" in capsys.readouterr().err

@@ -1,0 +1,218 @@
+"""Metrics exposition (``core/metrics.py``): nearest-rank percentile
+edge behavior (empty / single observation / q=0 / q=1 / ring
+wraparound past KEEP), the Prometheus text renderer (format validity,
+label folding, counter round-trip), the atomic file exposition, and the
+``trace metrics`` subcommand."""
+
+import glob
+import json
+import os
+import re
+
+import pytest
+
+from cme213_tpu.core import metrics
+from cme213_tpu.core.metrics import (
+    KEEP,
+    Histogram,
+    _nearest_rank,
+    render_prometheus,
+    write_exposition,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry(monkeypatch):
+    monkeypatch.delenv(metrics.METRICS_FILE_ENV, raising=False)
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# ------------------------------------------------------- percentile edges
+
+def test_percentile_empty_histogram_is_none():
+    h = Histogram("empty")
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert h.percentile(q) is None
+
+
+def test_percentile_single_observation_all_quantiles():
+    h = Histogram("one").observe(42.0)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert h.percentile(q) == 42.0
+
+
+def test_percentile_q0_q1_are_window_extremes():
+    h = Histogram("ends")
+    for v in (7.0, 3.0, 9.0, 5.0):
+        h.observe(v)
+    assert h.percentile(0.0) == 3.0
+    assert h.percentile(1.0) == 9.0
+
+
+def test_percentile_nearest_rank_pinned():
+    h = Histogram("nr")
+    for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+        h.observe(v)
+    # nearest rank: sorted[ceil(q*n) - 1]
+    assert h.percentile(0.50) == 3.0          # ceil(2.5)=3 -> index 2
+    assert h.percentile(0.25) == 2.0          # ceil(1.25)=2 -> index 1
+    assert h.percentile(0.99) == 100.0        # ceil(4.95)=5 -> index 4
+    assert _nearest_rank([], 0.5) is None
+
+
+def test_percentile_ring_wraparound_past_keep():
+    """Past KEEP observations, percentiles see only the retained window
+    while count/sum/min/max stay exact over the full stream."""
+    h = Histogram("ring")
+    n = KEEP + 904                            # 5000 with KEEP=4096
+    for v in range(1, n + 1):
+        h.observe(float(v))
+    assert h.count == n
+    assert h.total == n * (n + 1) / 2
+    assert h.min == 1.0 and h.max == float(n)
+    assert h.percentile(0.0) == float(n - KEEP + 1)   # oldest retained
+    assert h.percentile(1.0) == float(n)
+
+
+# ------------------------------------------------------ prometheus render
+
+_TYPE_LINE = re.compile(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                        r"(counter|gauge|summary)$")
+_SAMPLE_LINE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{[a-zA-Z0-9_]+="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z0-9_]+="(?:[^"\\]|\\.)*")*\})?'
+    r" (?P<value>[^ ]+)$")
+
+
+def _validate(text):
+    """Prometheus text-format validator: every line is a TYPE line or a
+    ``name{labels} value`` sample with a float-parseable value."""
+    samples = {}
+    for line in text.rstrip("\n").split("\n"):
+        if _TYPE_LINE.match(line):
+            continue
+        m = _SAMPLE_LINE.match(line)
+        assert m, f"malformed exposition line: {line!r}"
+        float(m.group("value"))
+        samples[line.rsplit(" ", 1)[0]] = float(m.group("value"))
+    return samples
+
+
+def test_render_prometheus_round_trips_format_validator():
+    metrics.counter("serve.shed.queue-full").inc(2)
+    metrics.counter("serve.tenant.t0.served").inc(4)
+    metrics.counter("served.echo.fast").inc()
+    metrics.counter("faults.slow").inc(5)
+    metrics.counter("checkpoint.rollbacks").inc()
+    metrics.gauge("serve.slo.burn").set(1.5)
+    metrics.gauge("world-size").set(8)
+    metrics.gauge("last-op").set("heat2d")     # string: no sample
+    metrics.gauge("armed").set(True)           # bool: no sample
+    for v in range(1, 101):
+        metrics.histogram("serve.latency.ms").observe(float(v))
+
+    samples = _validate(render_prometheus())
+    # dotted families fold their variable segments into labels
+    assert samples['cme213_serve_shed_total{reason="queue-full"}'] == 2
+    assert samples['cme213_serve_tenant_served_total{tenant="t0"}'] == 4
+    assert samples['cme213_served_total{op="echo",rung="fast"}'] == 1
+    assert samples['cme213_faults_total{kind="slow"}'] == 5
+    # flat names sanitize dots/dashes to underscores
+    assert samples["cme213_checkpoint_rollbacks_total"] == 1
+    assert samples["cme213_serve_slo_burn"] == 1.5
+    assert samples["cme213_world_size"] == 8
+    assert not any("last_op" in k or "armed" in k for k in samples)
+    # histograms render as summaries: retained-window quantiles + exact
+    # sum/count
+    assert samples['cme213_serve_latency_ms{quantile="0.5"}'] == 50.0
+    assert samples['cme213_serve_latency_ms{quantile="0.99"}'] == 99.0
+    assert samples["cme213_serve_latency_ms_sum"] == 5050.0
+    assert samples["cme213_serve_latency_ms_count"] == 100
+
+
+def test_render_prometheus_escapes_label_values():
+    metrics.counter('serve.shed.we"ird\\reason').inc()
+    samples = _validate(render_prometheus())
+    assert samples['cme213_serve_shed_total{reason="we\\"ird\\\\reason"}'] == 1
+
+
+def test_render_prometheus_empty_registry_and_explicit_snapshot():
+    assert render_prometheus() == ""
+    metrics.counter("a.b").inc()
+    snap = metrics.snapshot()
+    metrics.reset()
+    assert "cme213_a_b_total 1" in render_prometheus(snap)
+
+
+# ----------------------------------------------------------- file exposition
+
+def test_write_exposition_noop_without_destination():
+    metrics.counter("x").inc()
+    assert write_exposition() is None
+
+
+def test_write_exposition_env_path_atomic(tmp_path, monkeypatch):
+    out = tmp_path / "metrics.prom"
+    monkeypatch.setenv(metrics.METRICS_FILE_ENV, str(out))
+    metrics.counter("serve.batches").inc(3)
+    assert write_exposition() == str(out)
+    text = out.read_text()
+    assert _validate(text)["cme213_serve_batches_total"] == 3
+    assert text == render_prometheus()
+    assert glob.glob(str(tmp_path / "*.tmp*")) == []
+    # repeat writes replace, never append
+    metrics.counter("serve.batches").inc()
+    write_exposition()
+    assert _validate(out.read_text())["cme213_serve_batches_total"] == 4
+
+
+# ------------------------------------------------------- trace metrics CLI
+
+def _trace_main(argv):
+    from cme213_tpu.trace_cli import main
+    return main(argv)
+
+
+def test_trace_metrics_from_snapshot_json(tmp_path, capsys):
+    metrics.counter("faults.fail").inc(2)
+    f = tmp_path / "snap.json"
+    f.write_text(json.dumps(metrics.snapshot()))
+    assert _trace_main(["metrics", str(f)]) == 0
+    out = capsys.readouterr().out
+    assert 'cme213_faults_total{kind="fail"} 2' in out
+    _validate(out)
+
+
+def test_trace_metrics_from_flight_dump(tmp_path, capsys):
+    metrics.counter("serve.failed").inc()
+    doc = {"flight": 1, "reason": "rankkill", "events": [],
+           "metrics": metrics.snapshot()}
+    f = tmp_path / "flight-1-2-3.json"
+    f.write_text(json.dumps(doc))
+    assert _trace_main(["metrics", str(f)]) == 0
+    assert "cme213_serve_failed_total 1" in capsys.readouterr().out
+
+
+def test_trace_metrics_from_trace_jsonl(tmp_path, capsys):
+    metrics.counter("retries").inc(7)
+    f = tmp_path / "trace.jsonl"
+    f.write_text(
+        json.dumps({"event": "heartbeat", "t": 0.5, "rank": 0, "step": 1})
+        + "\n"
+        + json.dumps({"event": "metrics-snapshot", "t": 1.0,
+                      "metrics": metrics.snapshot()}) + "\n")
+    assert _trace_main(["metrics", str(f)]) == 0
+    assert "cme213_retries_total 7" in capsys.readouterr().out
+
+
+def test_trace_metrics_rejects_snapshotless_input(tmp_path, capsys):
+    f = tmp_path / "nothing.json"
+    f.write_text('{"foo": 1}')
+    assert _trace_main(["metrics", str(f)]) == 2
+    assert "trace:" in capsys.readouterr().err
+    g = tmp_path / "garbage.txt"
+    g.write_text("hello\n")
+    assert _trace_main(["metrics", str(g)]) == 2

@@ -20,6 +20,7 @@ from cme213_tpu.core.resilience import VirtualClock
 from cme213_tpu.serve import (
     ADMISSION,
     DEADLINE,
+    FAILED,
     OK,
     QUEUE_FULL,
     SHED,
@@ -580,3 +581,129 @@ def test_trace_summary_serving_section():
     assert serving["batches"] >= 1
     assert serving["shed"].get("echo:queue-full") == 1
     assert serving["degraded_batches"] >= 1
+
+
+# ----------------------------------------------------- request lifecycle
+
+def test_request_timing_phases_sum_to_total():
+    """Every phase stamp comes from the server clock, so the phase
+    breakdown sums to total_ms up to per-field rounding."""
+    clock = VirtualClock()
+    server, _ = echo_server(clock=clock, max_batch=2)
+    server.submit("echo", ("k", 1))
+    clock.advance(0.05)                        # 50ms queued before step
+    with faults.injected("slow:serve.echo:20"):
+        (res,) = server.step()
+    t = res.timing
+    assert t["queue_ms"] == 50.0 and t["run_ms"] == 20.0
+    phase_sum = (t["queue_ms"] + t["admit_ms"] + t["batch_wait_ms"]
+                 + t["run_ms"])
+    assert abs(phase_sum - t["total_ms"]) < 0.005
+    ev = trace.events("request-served")[-1]
+    assert ev["status"] == OK and ev["total_ms"] == t["total_ms"]
+    assert ev["run_ms"] == 20.0
+    # per-phase histograms observed once per served request
+    assert metrics.histogram("serve.request.total_ms").count == 1
+    assert metrics.histogram("serve.request.run_ms").percentile(1.0) == 20.0
+
+
+def test_request_served_event_links_batch_span():
+    server, _ = echo_server(max_batch=4)
+    server.submit("echo", ("k", 1))
+    server.submit("echo", ("k", 2))
+    server.drain()
+    reqs = trace.events("request-served")
+    assert len(reqs) == 2
+    batch_ids = {e["batch"] for e in reqs}
+    assert len(batch_ids) == 1                 # same batch -> same span
+    span_ids = {e["id"] for e in trace.events("span-begin")
+                if e.get("span") == "serve.batch"}
+    assert batch_ids <= span_ids               # rid -> serve.batch linkage
+
+
+def test_failed_request_lifecycle_and_tenant_counter():
+    server, _ = echo_server()
+    server.submit("echo", ("k", 1), tenant="acme")
+    with faults.injected("fail:serve.echo.fast,fail:serve.echo.safe"):
+        (res,) = server.step()
+    assert res.status == FAILED and res.tenant == "acme"
+    assert res.timing["total_ms"] is not None
+    ev = trace.events("request-served")[-1]
+    assert ev["status"] == FAILED and ev["tenant"] == "acme"
+    assert metrics.counter("serve.tenant.acme.failed").value == 1
+
+
+def test_tenant_counters_and_shed_tags():
+    server, _ = echo_server(capacity=1)
+    server.submit("echo", ("k", 1), tenant="a")
+    shed = server.submit("echo", ("k", 2), tenant="b")   # queue full
+    assert shed.status == SHED and shed.tenant == "b"
+    server.drain()
+    assert metrics.counter("serve.tenant.a.requests").value == 1
+    assert metrics.counter("serve.tenant.a.served").value == 1
+    assert metrics.counter("serve.tenant.b.requests").value == 1
+    assert metrics.counter("serve.tenant.b.shed").value == 1
+    ev = trace.events("queue-shed")[-1]
+    assert ev["tenant"] == "b" and ev["age_ms"] == 0.0 and ev["depth"] == 1
+
+
+def test_deadline_shed_carries_depth_and_age():
+    clock = VirtualClock()
+    server, _ = echo_server(clock=clock)
+    server.submit("echo", ("k", 1), deadline_ms=50, tenant="late")
+    clock.advance(0.2)
+    (res,) = server.step()
+    assert res.status == SHED and res.reason == DEADLINE
+    ev = trace.events("deadline-shed")[-1]
+    assert ev["depth"] == 0                    # already pulled off queue
+    assert ev["age_ms"] == 200.0 and ev["tenant"] == "late"
+
+
+def test_summary_zero_count_shed_keys_and_lifecycle_sections():
+    import io
+
+    from cme213_tpu.trace_cli import summarize
+
+    server, _ = echo_server(max_batch=2)
+    server.submit("echo", ("k", 1), tenant="a")
+    server.submit("echo", ("k", 2), tenant="b")
+    server.drain()                             # all served, nothing shed
+    out = io.StringIO()
+    agg = summarize(trace.events(), out=out)
+    # stable shed keys: zero-filled for every (serving op, reason) pair
+    assert agg["serving"]["shed"] == {"echo:admission": 0,
+                                      "echo:deadline": 0,
+                                      "echo:queue-full": 0}
+    assert set(agg["phases"]) == {"echo", "overall"}
+    assert agg["phases"]["overall"]["total_ms"]["p50"] is not None
+    assert agg["tenants"]["a"]["served"] == 1
+    assert agg["tenants"]["b"]["served"] == 1
+    assert agg["slo"] is None                  # no monitor ran
+    text = out.getvalue()
+    assert "request phases" in text and "tenants:" in text
+
+
+def test_loadgen_report_phases_tenants_slo_sections():
+    from cme213_tpu.serve.loadgen import format_report
+    from cme213_tpu.serve.slo import Objective, SLOMonitor
+
+    specs = build_mix("cipher", 12, seed=0, tenants=2)
+    assert {s.tenant for s in specs} == {"t0", "t1"}
+    mon = SLOMonitor([Objective("p99-latency", "p99_latency_ms", 1e9)])
+    server = Server(max_batch=4, capacity=16, slo=mon)
+    before = metrics.snapshot()
+    run = run_load(server, specs, mode="closed", concurrency=6)
+    report = slo_report(run, before, metrics.snapshot(), slo=mon)
+    assert report["served"] == 12
+    overall = report["phases"]["overall"]
+    assert set(overall) == {"queue", "admit", "batch_wait", "run", "total"}
+    assert overall["total"]["p50"] is not None
+    assert overall["total"]["p99"] >= overall["total"]["p50"]
+    tn = report["tenants"]
+    assert tn["t0"]["served"] + tn["t1"]["served"] == 12
+    assert tn["t0"]["latency_ms"]["p50"] is not None
+    assert report["slo"]["objectives"]["p99-latency"]["burning"] is False
+    assert report["slo"]["burn_events"] == 0
+    text = format_report(report)
+    assert "phase attribution" in text and "tenants:" in text
+    assert "slo:" in text
