@@ -68,11 +68,32 @@ def _apply_platform_env() -> None:
     apply_platform_env()
 
 
-def _preflight(seconds: float = 90.0) -> bool:
-    """Device-reachability watchdog (see core/platform.device_preflight)."""
-    from cme213_tpu.core.platform import device_preflight
+class DeviceUnreachable(RuntimeError):
+    """Preflight watchdog failure — classifies RUNTIME, the one failure
+    kind the bench retry policy backs off and retries on."""
 
-    return device_preflight(seconds)
+
+def _preflight(seconds: float = 90.0, retry_sleep=None) -> bool:
+    """Device-reachability watchdog (see core/platform.device_preflight),
+    retried once through the shared ``core.resilience.RetryPolicy`` so a
+    single dropped probe doesn't fail the whole child."""
+    import time as _time
+
+    from cme213_tpu.core.platform import device_preflight
+    from cme213_tpu.core.resilience import FailureKind, RetryPolicy
+
+    def probe() -> bool:
+        if not device_preflight(seconds):
+            raise DeviceUnreachable(f"no device response in {seconds}s")
+        return True
+
+    policy = RetryPolicy(max_retries=1, base_delay_s=5.0, multiplier=1.0,
+                         max_delay_s=5.0, retry_on=(FailureKind.RUNTIME,),
+                         sleep=retry_sleep or _time.sleep)
+    try:
+        return policy.run(probe, op="bench.preflight")
+    except DeviceUnreachable:
+        return False
 
 
 def _make_candidate(name: str, params, on_tpu: bool):
@@ -265,15 +286,53 @@ def measure_one(name: str, dtype_name: str) -> dict:
     }
 
 
-def run_children(dtype_name: str, budget_s: float = 2700.0) -> list[dict]:
+def _attempt_kernel(name: str, dtype_name: str) -> dict:
+    """One child-process measurement attempt.
+
+    Raises :class:`DeviceUnreachable` on a preflight exit (retryable:
+    the retry policy backs off and reruns); returns an error row for a
+    timeout (NOT retryable: with no result in 900 s the second cold
+    attempt would do the same compile again and time out the same way —
+    the persistent compile cache only helps once a compile has ever
+    FINISHED) or any other child failure.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), _CHILD_FLAG,
+             f"--kernel={name}", f"--dtype={dtype_name}"],
+            timeout=900, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return {"kernel": name, "ok": False, "error": "timeout (900s)"}
+    sys.stderr.write(proc.stderr)
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    if lines:
+        return json.loads(lines[-1])
+    if proc.returncode == _PREFLIGHT_EXIT:
+        raise DeviceUnreachable(f"{name}: preflight device unreachable")
+    return {"kernel": name, "ok": False,
+            "error": f"child exit {proc.returncode}"}
+
+
+def run_children(dtype_name: str, budget_s: float = 2700.0,
+                 retry_sleep=None) -> list[dict]:
     """Run every candidate in its own subprocess; collect per-kernel rows.
 
-    Two consecutive device-unreachable kernels (or an exhausted global
+    Per-kernel retry goes through ``core.resilience.RetryPolicy``: one
+    retry after a deterministic 120 s backoff, and ONLY on a
+    device-unreachable preflight (RUNTIME) — timeouts and child crashes
+    are not retried (see ``_attempt_kernel``).  ``retry_sleep`` is
+    injectable so tests never wait the backoff for real.  Two
+    consecutive device-unreachable kernels (or an exhausted global
     budget) short-circuit the remaining candidates — a dead tunnel would
     otherwise cost 90 s preflight + 120 s recovery sleep per kernel.
     """
     import time as _time
 
+    from cme213_tpu.core.resilience import FailureKind, RetryPolicy
+
+    policy = RetryPolicy(max_retries=1, base_delay_s=120.0, multiplier=1.0,
+                         max_delay_s=120.0, retry_on=(FailureKind.RUNTIME,),
+                         sleep=retry_sleep or _time.sleep)
     deadline = _time.monotonic() + budget_s
     rows = []
     dead_streak = 0
@@ -289,34 +348,12 @@ def run_children(dtype_name: str, budget_s: float = 2700.0) -> list[dict]:
                          "error": "skipped: device unreachable"
                          if dead_streak >= 2 else "skipped: bench budget"})
             continue
-        row = None
-        for attempt in range(2):
-            try:
-                proc = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__), _CHILD_FLAG,
-                     f"--kernel={name}", f"--dtype={dtype_name}"],
-                    timeout=900, capture_output=True, text=True)
-            except subprocess.TimeoutExpired:
-                # no retry: with no result in 900 s the second cold attempt
-                # would do the same compile again and time out the same way
-                # (the persistent compile cache only helps once a compile
-                # has ever FINISHED); move on and keep the window
-                row = {"kernel": name, "ok": False, "error": "timeout (900s)"}
-                break
-            sys.stderr.write(proc.stderr)
-            lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
-            if lines:
-                row = json.loads(lines[-1])
-                break
-            if proc.returncode == _PREFLIGHT_EXIT:
-                row = {"kernel": name, "ok": False,
-                       "error": "preflight: device unreachable"}
-                if attempt == 0:
-                    _time.sleep(120)  # wedged tunnel: let it recover
-                continue
+        try:
+            row = policy.run(lambda: _attempt_kernel(name, dtype_name),
+                             op="bench.heat2d")
+        except DeviceUnreachable:
             row = {"kernel": name, "ok": False,
-                   "error": f"child exit {proc.returncode}"}
-            break
+                   "error": "preflight: device unreachable"}
         platform = row.get("platform", platform)
         # only preflight failures indicate a dead device — a wedged tunnel
         # fails the 90 s preflight watchdog (exit 42), while a 900 s child

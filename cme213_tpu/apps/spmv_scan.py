@@ -182,9 +182,23 @@ _SCAN_KERNELS = {
 }
 
 
-@partial(jax.jit, static_argnames=("iters", "scan"), donate_argnums=(0,))
-def _iterate(a, xx, flags, iters: int, scan: str = "auto"):
-    scan_fn = _SCAN_KERNELS[scan]
+def _scan_fn(scan: str, block_size: int | None):
+    """The scan callable for a kernel name, with the blocked form's
+    block size pinned when the caller (or the tuner) chose one —
+    ``block_size`` is a jit static, so each choice is its own cached
+    program."""
+    if block_size is None or scan == "flat":
+        return _SCAN_KERNELS[scan]
+    if scan == "blocked":
+        return lambda v, f: segmented_scan_blocked(v, f, block_size)
+    return lambda v, f: segmented_scan(v, f, block_size=block_size)
+
+
+@partial(jax.jit, static_argnames=("iters", "scan", "block_size"),
+         donate_argnums=(0,))
+def _iterate(a, xx, flags, iters: int, scan: str = "auto",
+             block_size: int | None = None):
+    scan_fn = _scan_fn(scan, block_size)
 
     def body(_, v):
         return scan_fn(v * xx, flags)
@@ -192,8 +206,10 @@ def _iterate(a, xx, flags, iters: int, scan: str = "auto"):
     return jax.lax.fori_loop(0, iters, body, a)
 
 
-@partial(jax.jit, static_argnames=("iters", "scan"), donate_argnums=(0,))
-def _iterate_batched(a, xx, flags, iters: int, scan: str = "flat"):
+@partial(jax.jit, static_argnames=("iters", "scan", "block_size"),
+         donate_argnums=(0,))
+def _iterate_batched(a, xx, flags, iters: int, scan: str = "flat",
+                     block_size: int | None = None):
     """B same-shape solves as ONE device program: ``a``/``xx``/``flags``
     are (B, n) stacks and the whole batch runs under ``jax.vmap`` of the
     single-solve loop — per-lane arithmetic is the exact expression
@@ -202,7 +218,7 @@ def _iterate_batched(a, xx, flags, iters: int, scan: str = "flat"):
     differ freely across lanes (flags are per-lane vectors); only
     ``(n, iters, dtype)`` must match, which is what the serving layer's
     shape-class buckets guarantee."""
-    scan_fn = _SCAN_KERNELS[scan]
+    scan_fn = _scan_fn(scan, block_size)
 
     def one(v0, xxi, fi):
         def body(_, v):
@@ -269,9 +285,22 @@ def run_spmv_scan_batched(probs: list[Problem], kernel: str = "flat",
     b = len(probs)
     shape_class = f"n{n}/i{iters}/b{b}"
 
+    # the serve adapters land here: blocked/auto batches consult the
+    # tuner for the measured block size of this size bucket
+    tuned_block = None
+    if kernel in ("auto", "blocked"):
+        from ..core import tune
+
+        tuned_block = tune.resolve(
+            "spmv_scan", f"n{programs.canonical_size(n)}",
+            np.dtype(dtype).name, block_size=None)["block_size"]
+    static = {"iters": iters, "batch": b}
+    if tuned_block is not None:
+        static["block_size"] = tuned_block
+
     def build():
-        return lambda a, xx, flags: _iterate_batched(a, xx, flags, iters,
-                                                     scan=kernel)
+        return lambda a, xx, flags: _iterate_batched(
+            a, xx, flags, iters, scan=kernel, block_size=tuned_block)
 
     def warm(fn):
         check_op(f"spmv_scan_batched.{kernel}",
@@ -279,8 +308,7 @@ def run_spmv_scan_batched(probs: list[Problem], kernel: str = "flat",
                     jnp.zeros((b, n), jnp.int32)))
 
     runner = programs.get("spmv_scan_batched", kernel, shape_class, build,
-                          dtype=np.dtype(dtype).name, warm=warm,
-                          iters=iters, batch=b)
+                          dtype=np.dtype(dtype).name, warm=warm, **static)
     with span("spmv_scan_batched.run", kernel=kernel,
               shape_class=shape_class) as sp:
         out = runner(a, xx, flags)
@@ -379,12 +407,14 @@ def _conformance_gate(n: int, dtype):
     size dispatch would actually pick for ``n``, so the probed kernel is
     the serving kernel."""
     from ..core import conformance
-    from ..ops.segmented import BLOCKED_SCAN_THRESHOLD
+    from ..ops.segmented import scan_threshold
 
     def gate(rung: str) -> bool:
         kernel = rung
         if kernel == "auto":
-            kernel = "flat" if n < BLOCKED_SCAN_THRESHOLD else "blocked"
+            # the tuned-or-default crossover, so the probed kernel is
+            # the one the size dispatch actually serves for this n
+            kernel = "flat" if n < scan_threshold() else "blocked"
         if kernel == "flat":
             return True  # the reference rung needs no probe
         prob = _probe_problem()
@@ -411,7 +441,8 @@ def _conformance_gate(n: int, dtype):
 
 
 def _build_runner(kernel: str, iters: int, interpret: bool | None = None,
-                  max_len: int | None = None):
+                  max_len: int | None = None,
+                  block_size: int | None = None):
     """Shape-polymorphic runner ``fn(a, xx, flags, starts)`` executing all
     ``iters`` iterations with the named kernel.  Every per-problem array
     is an **argument** (never closed over) so the callable can live in the
@@ -428,7 +459,7 @@ def _build_runner(kernel: str, iters: int, interpret: bool | None = None,
             a, xx, flags, iters, interpret=interpret)
     if kernel in _SCAN_KERNELS:
         return lambda a, xx, flags, starts: _iterate(
-            a, xx, flags, iters, scan=kernel)
+            a, xx, flags, iters, scan=kernel, block_size=block_size)
     if kernel == "dense":
         return lambda a, xx, flags, starts: _iterate_dense(
             a, xx, starts, iters, max_len)
@@ -436,7 +467,7 @@ def _build_runner(kernel: str, iters: int, interpret: bool | None = None,
 
 
 def _program(rung: str, n: int, iters: int, dtype, p: int | None = None,
-             max_len: int | None = None):
+             max_len: int | None = None, block_size: int | None = None):
     """The cached program for ``(rung, n{n}/i{iters}, dtype)`` — built and
     warmed once per process (``core/programs.py``), a dict lookup ever
     after.  The warmup runs on zero inputs of the class's shapes behind
@@ -447,6 +478,13 @@ def _program(rung: str, n: int, iters: int, dtype, p: int | None = None,
 
     static = {"iters": iters}
     interpret = None
+    if rung not in ("auto", "blocked"):
+        block_size = None  # a tuned block size only shapes the XLA scans
+    if block_size is not None:
+        # the tuned static rides in the program key: a dispatch that
+        # resolves a different winner compiles (and caches) its own
+        # program instead of silently reusing the old block shape
+        static["block_size"] = block_size
     if rung in ("pallas", "pallas-fused"):
         interpret = jax.devices()[0].platform != "tpu"
         static["interpret"] = interpret
@@ -457,7 +495,7 @@ def _program(rung: str, n: int, iters: int, dtype, p: int | None = None,
 
     def build():
         return _build_runner(rung, iters, interpret=interpret,
-                             max_len=max_len)
+                             max_len=max_len, block_size=block_size)
 
     def warm(fn):
         check_op(f"spmv_scan.{rung}",
@@ -544,12 +582,10 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
     — padded-then-sliced must match the unpadded solve bitwise — and a
     failing probe silently falls back to the exact shape.
     """
-    from ..core import roofline, span, with_fallback
+    from ..core import programs, roofline, span, tune, with_fallback
 
     prob.validate()
     if canonical:
-        from ..core import programs
-
         n_to = programs.canonical_size(prob.n)
         if n_to != prob.n and _bucket_gate(n_to, kernel, dtype):
             out = run_spmv_scan(pad_problem(prob, n_to), timer=timer,
@@ -565,6 +601,24 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
     shape_class = f"n{prob.n}/i{prob.iters}"
     cost = roofline.spmv_scan_cost(prob.n, prob.iters, dtype=dtype)
 
+    # tuned-or-default statics (core/tune.py, keyed by the canonical
+    # size bucket): "auto" serves the measured kernel choice and the
+    # blocked scans serve the measured block size; ``CME213_TUNE=0`` or
+    # an empty cache leaves every default in place
+    tuned_block = None
+    if kernel in ("auto", "blocked"):
+        bucket = f"n{programs.canonical_size(prob.n)}"
+        if kernel == "auto":
+            t = tune.resolve("spmv_scan", bucket, np.dtype(dtype).name,
+                             kernel="auto", block_size=None)
+            if t["kernel"] in ("flat", "blocked"):
+                kernel = t["kernel"]
+            tuned_block = t["block_size"]
+        else:
+            tuned_block = tune.resolve("spmv_scan", bucket,
+                                       np.dtype(dtype).name,
+                                       block_size=None)["block_size"]
+
     def attempt(rung: str):
         def thunk():
             # the process-wide program cache replaces the old per-call
@@ -576,7 +630,7 @@ def run_spmv_scan(prob: Problem, timer: PhaseTimer | None = None,
             # between cudaEvents); a hit is one dict lookup, so a second
             # call on a known shape class performs zero retraces
             runner = _program(rung, prob.n, prob.iters, dtype, p=prob.p,
-                              max_len=max_len)
+                              max_len=max_len, block_size=tuned_block)
             # every kernel donates its value buffer, so each attempt gets
             # a fresh host->device upload — a rung that dies mid-run must
             # not leave the next rung a donated (invalid) buffer

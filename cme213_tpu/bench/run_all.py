@@ -123,6 +123,9 @@ def main(argv=None) -> int:
              ns=(1 << 12,) if q else (1 << 16, 1 << 20, 1 << 22),
              iters=2 if q else 8,
              kernels=("flat", "blocked") if q else None)),
+        ("sort_sweep.csv",
+         lambda: sweeps.sort_sweep(
+             ns=(1 << 12,) if q else (1 << 16, 1 << 20))),
     ]
     if only is not None:
         known = {f[:-len(".csv")] for f, _ in jobs}

@@ -482,6 +482,55 @@ def sort_thread_sweep(num_elements: int = 1_000_000,
     return rows
 
 
+def sort_sweep(ns=(1 << 16, 1 << 20),
+               kernels=("lax", "radix", "bitonic", "auto")) -> list[dict]:
+    """TPU-resident sorts vs size: the ``lax.sort`` library path, the
+    4-phase radix, the bitonic network, and the tuned ``auto`` dispatch
+    (``ops.sort.sort_auto``) — the crossover table ``tune run --op sort``
+    measures, re-read here as data.  Byte accounting via
+    ``roofline.sort_cost`` (radix: 4 scatter passes; merge/bitonic:
+    log2(n) compare-exchange passes); every row carries ``pct_peak`` /
+    ``bound`` and the ``tuned`` column names the cached winner the auto
+    row dispatched to (empty: no winner cached, auto == lax)."""
+    import jax.numpy as jnp
+
+    from ..core import programs, tune
+    from ..core.roofline import sort_cost
+    # NOT ``from ..ops import sort``: the package re-exports the sort
+    # *function* under that name, shadowing the submodule attribute
+    from ..ops.sort import bitonic_sort, radix_sort, sort, sort_auto
+
+    fns = {"lax": sort, "radix": radix_sort,
+           "bitonic": bitonic_sort, "auto": sort_auto}
+    rows = []
+    for n in ns:
+        rng = np.random.default_rng(n % 97)
+        keys_host = rng.integers(0, 2 ** 32, n, dtype=np.uint32)
+        keys = jnp.asarray(keys_host)
+        expect = np.sort(keys_host)
+        rec = tune.lookup("sort", f"n{programs.canonical_size(n)}", "uint32")
+        tuned = rec["candidate"] if rec else ""
+        for kernel in kernels:
+            resolved = tuned or "lax" if kernel == "auto" else kernel
+            cost = sort_cost(n, kind="radix" if resolved == "radix"
+                             else "merge")
+            try:
+                ms = _time_ms(fns[kernel], keys)
+                ok = bool((np.asarray(fns[kernel](keys)) == expect).all())
+            except Exception as e:  # a kernel failing at a size is data
+                _raise_if_device_error(e)
+                rows.append({"n": n, "kernel": kernel, "tuned": tuned,
+                             "ms": -1.0, "gbs": 0.0, "ok": False,
+                             "error": type(e).__name__,
+                             "pct_peak": "", "bound": ""})
+                continue
+            rows.append({"n": n, "kernel": kernel, "tuned": tuned,
+                         "ms": round(ms, 3),
+                         "gbs": round(cost.gbs(ms), 3), "ok": ok,
+                         "error": "", **_attrib(cost.gbs(ms))})
+    return rows
+
+
 def dist_heat_sweep(size: int = 256, order: int = 8, iters: int = 20,
                     ndevs=(1, 2, 4, 8),
                     pallas: bool | None = None) -> list[dict]:
@@ -663,6 +712,7 @@ def spmv_scan_sweep(ns=(1 << 16, 1 << 20, 1 << 22), iters: int = 8,
         kernels = (("flat", "blocked", "pallas", "pallas-fused")
                    if jax.devices()[0].platform == "tpu"
                    else ("flat", "blocked"))
+    from ..core import programs, tune
     from ..core.roofline import spmv_scan_cost
 
     rows = []
@@ -671,6 +721,10 @@ def spmv_scan_sweep(ns=(1 << 16, 1 << 20, 1 << 22), iters: int = 8,
         prob = sp.generate_problem(n, p, max(2, p - 1), iters=iters,
                                    seed=n % 97)
         cost = spmv_scan_cost(n, iters)
+        # the cached autotuner winner for this size class, as a column:
+        # rows from a tuned capture say which config dispatch would pick
+        rec = tune.lookup("spmv_scan", f"n{programs.canonical_size(n)}")
+        tuned = rec["candidate"] if rec else ""
         for kernel in kernels:
             timer = PhaseTimer()
             try:
@@ -681,14 +735,15 @@ def spmv_scan_sweep(ns=(1 << 16, 1 << 20, 1 << 22), iters: int = 8,
             except Exception as e:  # a kernel failing at a shape is data
                 _raise_if_device_error(e)
                 rows.append({"n": n, "p": p, "iters": iters,
-                             "kernel": kernel, "ms": -1.0, "gbs": 0.0,
+                             "kernel": kernel, "tuned": tuned,
+                             "ms": -1.0, "gbs": 0.0,
                              "rel_l2": "", "error": type(e).__name__,
                              "pct_peak": "", "bound": ""})
                 continue
             errs = sp.external_check(prob, out)
             ms = timer.last_ms("spmv_scan")
             rows.append({"n": n, "p": p, "iters": iters, "kernel": kernel,
-                         "ms": round(ms, 3),
+                         "tuned": tuned, "ms": round(ms, 3),
                          "gbs": round(cost.gbs(ms), 3),
                          "rel_l2": f"{errs['rel_l2']:.2e}", "error": "",
                          **_attrib(cost.gbs(ms), cost.gflops(ms))})

@@ -100,6 +100,13 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     # program cache (core/programs.py; ROADMAP item 5's amortization half)
     "program-cache-hit": ("op", "rung", "shape_class"),
     "program-cache-miss": ("op", "rung", "shape_class"),
+    # autotuner (core/tune.py; ROADMAP item 2b): trial/winner from the
+    # measured search, hit/default from every dispatch-time consult
+    "tune-trial": ("op", "shape_class", "candidate", "ok", "ms", "gbs"),
+    "tune-winner": ("op", "shape_class", "dtype", "candidate", "statics",
+                    "gbs"),
+    "tune-hit": ("op", "shape_class", "statics"),
+    "tune-default": ("op", "shape_class"),
     # distributed commits (dist/ckpt.py)
     "epoch-commit": ("epoch", "step", "world", "shards", "ms"),
     "commit-invalid": ("candidate", "error", "message"),

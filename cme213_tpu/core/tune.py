@@ -1,0 +1,658 @@
+"""Measured autotuning of dispatch statics — ROADMAP item 2(b).
+
+The reference hand-tuned every performance-critical constant: hw2's
+shared-memory tile shapes and hw_final's warp-scan block sizes were
+chosen by a human sweeping configurations offline.  This repo inherited
+those choices as hard-coded statics — the blocked-scan threshold, heat
+``tile_y``/``tile_x``, serve batch widths — which, since the program
+cache keys on statics (``core/programs.py``), are exactly the knobs an
+empirical autotuner can turn: the classic ATLAS/FFTW mold, searching a
+small registered candidate space per op and persisting the measured
+winner for dispatch to consume.
+
+The search protocol, per candidate:
+
+1. **conformance-gate** (``core/conformance.py``) BEFORE any timing — a
+   candidate whose probe diverges from the op's reference (including a
+   ``wrong:<op>``-faulted probe) is excluded and can never win;
+2. **build + warm** through ``core/programs.py`` so compiles happen in
+   the usual ``<op>.compile`` spans, outside the timed region;
+3. **median-of-k** measured runs, each under a ``tune.trial`` span whose
+   declared cost (``core/roofline.py``) puts ``achieved_gbs``/
+   ``pct_peak``/``bound`` on the span-end record.
+
+Winners persist to a JSON disk cache (``CME213_TUNE_CACHE``) keyed
+``device_kind|op|shape_class|dtype`` — the same pattern as
+``CME213_CONFORMANCE_CACHE`` — and dispatch sites (``run_spmv_scan``,
+``run_heat_resilient``, the serve batcher, ``segmented_scan``'s size
+dispatch) resolve their statics as tuned-or-default via :func:`resolve`,
+with ``CME213_TUNE=0`` as the kill-switch restoring every built-in
+default.  Ties break deterministically: the first-registered candidate
+wins, and the measurement clock is injectable so the tie-break is
+testable without real timers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import metrics, roofline
+from .resilience import Clock
+from .trace import record_event, span
+
+#: on-disk winner cache (JSON) shared across processes
+CACHE_ENV = "CME213_TUNE_CACHE"
+#: kill-switch: ``CME213_TUNE=0`` makes every dispatch use its defaults
+KILL_ENV = "CME213_TUNE"
+
+#: measured runs per candidate (median taken)
+TRIAL_RUNS = 5
+
+
+class TuneError(RuntimeError):
+    """No conformant candidate survived the gate for an op."""
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One point in an op's search space.
+
+    ``gate`` is a zero-arg callable returning truthy when the candidate's
+    conformance probe passes (run BEFORE timing; ``None`` marks the op's
+    reference configuration, which needs no probe).  ``build`` returns
+    the zero-arg measured runner — building goes through
+    ``core/programs.py`` so the compile is warmed outside the timed
+    region.  ``scale`` divides the measured time for scoring (a serve
+    candidate batching ``w`` requests scores per-request)."""
+
+    label: str
+    statics: dict
+    build: object
+    gate: object = None
+    cost: roofline.Cost | None = None
+    scale: float = 1.0
+
+
+@dataclass(frozen=True)
+class TuneSpace:
+    """An op's registered candidate space for one shape class."""
+
+    op: str
+    shape_class: str
+    dtype: str
+    candidates: tuple
+    cost: roofline.Cost | None = None
+
+
+# key string -> winner record — the steady-state dict lookup
+_WINNERS: dict[str, dict] = {}
+_DISK_LOADED = False
+
+
+def reset() -> None:
+    """Forget every cached winner (tests); the disk cache is re-read."""
+    global _DISK_LOADED
+    _WINNERS.clear()
+    _DISK_LOADED = False
+
+
+def enabled() -> bool:
+    """The kill-switch: ``CME213_TUNE=0`` disables all tuned lookups."""
+    return os.environ.get(KILL_ENV, "1") != "0"
+
+
+def cache_path() -> str | None:
+    """The on-disk winner cache location, if one is configured."""
+    return os.environ.get(CACHE_ENV) or None
+
+
+def _cache_key(op: str, shape_class: str, dtype: str,
+               device: str | None = None) -> str:
+    return f"{device or roofline.detect_device()}|{op}|{shape_class}|{dtype}"
+
+
+def _load_disk_cache() -> None:
+    """Merge persisted winners (non-destructively: in-process wins)."""
+    global _DISK_LOADED
+    _DISK_LOADED = True
+    path = os.environ.get(CACHE_ENV)
+    if not path or not os.path.exists(path):
+        return
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return  # a corrupt cache must never break dispatch; defaults serve
+    for key, rec in data.items():
+        if (len(key.split("|")) != 4 or not isinstance(rec, dict)
+                or not isinstance(rec.get("statics"), dict)):
+            continue
+        _WINNERS.setdefault(key, dict(rec))
+
+
+def _persist(key: str, rec: dict) -> None:
+    path = os.environ.get(CACHE_ENV)
+    if not path:
+        return
+    try:
+        data = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except (OSError, ValueError):
+        data = {}
+    data[key] = rec
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=0, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # a read-only cache dir must never block dispatch
+
+
+def store(op: str, shape_class: str, dtype: str, *, statics: dict,
+          candidate: str, ms: float, gbs: float) -> dict:
+    """Record (and persist) the measured winner for a tuning key."""
+    rec = {"statics": dict(statics), "candidate": candidate,
+           "ms": round(float(ms), 3), "gbs": round(float(gbs), 3)}
+    key = _cache_key(op, shape_class, dtype)
+    _WINNERS[key] = rec
+    _persist(key, rec)
+    return rec
+
+
+def lookup(op: str, shape_class: str, dtype: str = "float32") -> dict | None:
+    """The winner record for a key, or None (also None when the
+    kill-switch is set).  Pure — no events; dispatch sites that should
+    count tuned-vs-default traffic go through :func:`resolve`."""
+    if not enabled():
+        return None
+    if not _DISK_LOADED:
+        _load_disk_cache()
+    return _WINNERS.get(_cache_key(op, shape_class, dtype))
+
+
+def resolve(op: str, shape_class: str, dtype: str = "float32",
+            **defaults) -> dict:
+    """Tuned-or-default statics for a dispatch site.
+
+    Returns ``defaults`` updated with the winning statics for the key —
+    restricted to keys the call site declares, so a stale cache entry
+    can never inject statics dispatch doesn't understand.  Counts every
+    consult (``tune.hits``/``tune.defaults``) and records a
+    ``tune-hit``/``tune-default`` event, the tuned-vs-default split the
+    ``trace summary`` tuning section reports."""
+    rec = lookup(op, shape_class, dtype)
+    if rec is None:
+        metrics.counter("tune.defaults").inc()
+        record_event("tune-default", op=op, shape_class=shape_class)
+        return dict(defaults)
+    tuned = {k: v for k, v in rec["statics"].items() if k in defaults}
+    metrics.counter("tune.hits").inc()
+    record_event("tune-hit", op=op, shape_class=shape_class,
+                 statics=json.dumps(tuned, sort_keys=True))
+    return {**defaults, **tuned}
+
+
+def entries() -> dict:
+    """Merged snapshot (disk + in-process) of every winner record."""
+    if not _DISK_LOADED:
+        _load_disk_cache()
+    return dict(_WINNERS)
+
+
+def clear() -> int:
+    """Drop every winner, in-process and on disk; returns the count."""
+    global _DISK_LOADED
+    if not _DISK_LOADED:
+        _load_disk_cache()
+    n = len(_WINNERS)
+    reset()
+    _DISK_LOADED = True  # do not resurrect the file we are clearing
+    path = os.environ.get(CACHE_ENV)
+    if path and os.path.exists(path):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    return n
+
+
+# ------------------------------------------------------------------ search
+
+def _median(xs: list[float]) -> float:
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def _measure(op: str, shape_class: str, cand: Candidate, runner,
+             clock: Clock, runs: int) -> float:
+    """Median-of-``runs`` scored milliseconds for one warmed candidate,
+    each run under a ``tune.trial`` span carrying roofline attribution."""
+    times = []
+    for _ in range(max(1, runs)):
+        t0 = clock.now()
+        with span("tune.trial", op=op, shape_class=shape_class,
+                  candidate=cand.label) as sp:
+            if cand.cost is not None:
+                sp.roofline(cand.cost.nbytes, cand.cost.flops)
+            out = runner()
+            sp.block(out)
+        times.append((clock.now() - t0) * 1e3 / cand.scale)
+    return _median(times)
+
+
+def run_space(space: TuneSpace, *, clock: Clock | None = None,
+              runs: int = TRIAL_RUNS, persist: bool = True) -> dict:
+    """Gate, warm, and time every candidate; pick and record the winner.
+
+    Deterministic: candidates are visited in registration order and only
+    a STRICTLY faster median displaces the incumbent, so exact ties go
+    to the earlier candidate whatever dict/scheduler noise does.  The
+    measurement clock is injectable (``core/resilience.Clock``) so the
+    tie-break is testable."""
+    clock = clock or Clock()
+    trials = []
+    best = None
+    for cand in space.candidates:
+        cost = cand.cost or space.cost
+        c = Candidate(cand.label, cand.statics, cand.build, cand.gate,
+                      cost, cand.scale)
+        try:
+            ok = True if cand.gate is None else bool(cand.gate())
+        except Exception as e:  # noqa: BLE001 — a dying probe is a veto
+            ok = False
+            trials.append({"candidate": cand.label, "ok": False,
+                           "ms": -1.0, "gbs": -1.0,
+                           "error": f"{type(e).__name__}: {e}"})
+        if not ok:
+            metrics.counter("tune.rejected").inc()
+            record_event("tune-trial", op=space.op,
+                         shape_class=space.shape_class,
+                         candidate=cand.label, ok=False, ms=-1.0, gbs=-1.0)
+            if not trials or trials[-1].get("candidate") != cand.label:
+                trials.append({"candidate": cand.label, "ok": False,
+                               "ms": -1.0, "gbs": -1.0,
+                               "error": "conformance probe failed"})
+            continue
+        try:
+            runner = cand.build()
+            ms = _measure(space.op, space.shape_class, c, runner, clock,
+                          runs)
+        except Exception as e:  # noqa: BLE001 — a candidate that cannot
+            # build or run (Mosaic lowering, OOM, injected fail) is
+            # excluded, not fatal: the search banks what it measured
+            metrics.counter("tune.rejected").inc()
+            record_event("tune-trial", op=space.op,
+                         shape_class=space.shape_class,
+                         candidate=cand.label, ok=False, ms=-1.0, gbs=-1.0)
+            trials.append({"candidate": cand.label, "ok": False,
+                           "ms": -1.0, "gbs": -1.0,
+                           "error": f"{type(e).__name__}: {e}"})
+            continue
+        gbs = cost.gbs(ms * cand.scale) if (cost and ms > 0) else 0.0
+        metrics.counter("tune.trials").inc()
+        record_event("tune-trial", op=space.op,
+                     shape_class=space.shape_class, candidate=cand.label,
+                     ok=True, ms=round(ms, 3), gbs=round(gbs, 3))
+        trial = {"candidate": cand.label, "ok": True,
+                 "ms": round(ms, 3), "gbs": round(gbs, 3),
+                 "statics": dict(cand.statics)}
+        trials.append(trial)
+        if best is None or ms < best["ms"]:
+            best = {"candidate": cand.label, "ms": ms, "gbs": gbs,
+                    "statics": dict(cand.statics)}
+    if best is None:
+        raise TuneError(
+            f"tune: no conformant candidate for {space.op} "
+            f"[{space.shape_class}/{space.dtype}] "
+            f"({len(space.candidates)} gated out)")
+    metrics.counter("tune.winners").inc()
+    record_event("tune-winner", op=space.op, shape_class=space.shape_class,
+                 dtype=space.dtype, candidate=best["candidate"],
+                 statics=json.dumps(best["statics"], sort_keys=True),
+                 gbs=round(best["gbs"], 3))
+    if persist:
+        store(space.op, space.shape_class, space.dtype,
+              statics=best["statics"], candidate=best["candidate"],
+              ms=best["ms"], gbs=best["gbs"])
+    return {"op": space.op, "shape_class": space.shape_class,
+            "dtype": space.dtype, "device": roofline.detect_device(),
+            "winner": {"candidate": best["candidate"],
+                       "statics": best["statics"],
+                       "ms": round(best["ms"], 3),
+                       "gbs": round(best["gbs"], 3)},
+            "trials": trials}
+
+
+# ------------------------------------------------------- candidate spaces
+
+#: blocked-scan block sizes searched for spmv_scan (the hw_final
+#: warp-scan sizing axis, minus the warp)
+SPMV_BLOCK_SIZES = (1024, 2048, 4096, 8192, 16384)
+#: flat/blocked crossover thresholds searched for segmented_scan's auto
+#: dispatch (current hard default: 2^16)
+SCAN_THRESHOLDS = (1 << 14, 1 << 16, 1 << 18)
+#: serve batch widths searched per bucket
+SERVE_WIDTHS = (1, 2, 4, 8)
+
+
+def _spmv_space(n: int = 1 << 20, iters: int = 8,
+                dtype: str = "float32",
+                block_sizes=SPMV_BLOCK_SIZES) -> TuneSpace:
+    """spmv_scan: flat log-sweep vs blocked O(n) at each block size.
+
+    The winner's statics (``kernel`` and, for blocked, ``block_size``)
+    are what ``run_spmv_scan``'s auto dispatch resolves."""
+    import jax.numpy as jnp
+
+    from ..apps import spmv_scan as app
+    from ..core import conformance, programs
+    from ..ops.segmented import head_flags_from_starts
+
+    jdt = np.dtype(dtype)
+    nc = programs.canonical_size(n)
+    prob = app.generate_problem(nc, p=max(2, nc // 64), q=max(2, nc // 2),
+                                iters=iters, seed=0)
+    cost = roofline.spmv_scan_cost(nc, iters, dtype=dtype)
+    probe = app._probe_problem()
+    probe_xx = jnp.asarray(probe.xx, jdt)
+    probe_flags = head_flags_from_starts(jnp.asarray(probe.s[:-1]), probe.n)
+    probe_starts = jnp.asarray(probe.s[:-1])
+
+    def probe_run(kernel, block_size=None):
+        def thunk():
+            fn = app._program(kernel, probe.n, probe.iters, jdt,
+                              p=probe.p, block_size=block_size)
+            return np.asarray(fn(jnp.asarray(probe.a, jdt), probe_xx,
+                                 probe_flags, probe_starts))
+        return thunk
+
+    def gate(label, kernel, block_size=None):
+        return lambda: conformance.check(
+            "spmv_scan", label, shape_class=np.dtype(dtype).name,
+            candidate=probe_run(kernel, block_size),
+            reference=probe_run("flat"),
+            rel_l2=app.CONFORMANCE_REL_L2[kernel]).ok
+
+    xx = jnp.asarray(prob.xx, jdt)
+    flags = head_flags_from_starts(jnp.asarray(prob.s[:-1]), prob.n)
+    starts = jnp.asarray(prob.s[:-1])
+
+    def build(kernel, block_size=None):
+        def builder():
+            fn = app._program(kernel, prob.n, prob.iters, jdt, p=prob.p,
+                              block_size=block_size)
+            # _iterate donates the value buffer, so every timed run pays
+            # the same fresh host->device upload — identical constant
+            # overhead for every candidate, so the ranking is unbiased
+            return lambda: fn(jnp.asarray(prob.a, jdt), xx, flags, starts)
+        return builder
+
+    cands = [Candidate("flat", {"kernel": "flat"}, build("flat"))]
+    for bs in block_sizes:
+        cands.append(Candidate(
+            f"blocked/bs{bs}", {"kernel": "blocked", "block_size": bs},
+            build("blocked", bs), gate(f"blocked/bs{bs}", "blocked", bs)))
+    return TuneSpace("spmv_scan", f"n{nc}", np.dtype(dtype).name,
+                     tuple(cands), cost)
+
+
+def _crossover_space(n: int | None = None, dtype: str = "float32",
+                     thresholds=SCAN_THRESHOLDS) -> TuneSpace:
+    """segmented_scan: the flat/blocked crossover threshold, measured at
+    the contested size (the default threshold itself).  Each candidate
+    IS a threshold; what gets timed is the kernel that threshold selects
+    at the probe size, so the measurement answers "which side of the
+    boundary should this size fall on"."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import conformance, programs
+    from ..ops import segmented
+
+    n0 = programs.canonical_size(n or segmented.BLOCKED_SCAN_THRESHOLD)
+    jdt = np.dtype(dtype)
+    rng = np.random.default_rng(0)
+    v_host = rng.uniform(-1, 1, n0).astype(dtype)
+    f_host = (rng.uniform(size=n0) < (1 / 64)).astype(np.int32)
+    f_host[0] = 1
+    v, f = jnp.asarray(v_host), jnp.asarray(f_host)
+    cost = roofline.Cost(n0 * (2 * jdt.itemsize + 4), 0)
+    pn = 4096
+    pv = jnp.asarray(v_host[:pn])
+    pf = jnp.asarray(f_host[:pn]).at[0].set(1)
+
+    def kernel_for(thr):
+        return "blocked" if n0 >= thr else "flat"
+
+    def program(kernel):
+        def build():
+            fn = {"flat": segmented.segmented_scan_flat,
+                  "blocked": segmented.segmented_scan_blocked}[kernel]
+            return jax.jit(lambda vv, ff: fn(vv, ff))
+
+        def warm(fn):
+            jax.block_until_ready(fn(jnp.zeros(n0, jdt),
+                                     jnp.zeros(n0, jnp.int32)))
+
+        return programs.get("segmented_scan", kernel, f"n{n0}", build,
+                            dtype=np.dtype(dtype).name, warm=warm)
+
+    def gate(label, kernel):
+        if kernel == "flat":
+            return None  # the reference form
+        return lambda: conformance.check(
+            "segmented_scan", label, shape_class=f"n{pn}",
+            candidate=lambda: np.asarray(
+                segmented.segmented_scan_blocked(pv, pf)),
+            reference=lambda: np.asarray(
+                segmented.segmented_scan_flat(pv, pf)),
+            rel_l2=1e-5).ok
+
+    cands = []
+    for thr in thresholds:
+        kernel = kernel_for(thr)
+        label = f"thr{thr}/{kernel}"
+        cands.append(Candidate(
+            label, {"threshold": thr},
+            (lambda k: lambda: (lambda fn: (lambda: fn(v, f)))(
+                program(k)))(kernel),
+            gate(label, kernel)))
+    return TuneSpace("segmented_scan", "crossover", np.dtype(dtype).name,
+                     tuple(cands), cost)
+
+
+def _heat_space(gy: int = 64, gx: int = 64, order: int = 2, k: int = 1,
+                iters: int = 4, dtype: str = "float32",
+                tile_ys=None, tile_x: int | None = None,
+                interpret: bool | None = None) -> TuneSpace:
+    """heat: pipeline ``tile_y`` (×``tile_x``) per order×k class, against
+    the XLA baseline.  Off-TPU the Pallas candidates time in interpret
+    mode, so on CPU the XLA baseline wins and the winner's statics are
+    empty — honest "defaults are best here" — while on TPU the same
+    space searches real tile shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import SimParams
+    from ..grid import make_initial_grid
+    from ..ops import run_heat
+    from ..ops import stencil_pipeline as sp_mod
+
+    p = SimParams(nx=gx, ny=gy, order=order, iters=iters)
+    u0 = np.asarray(make_initial_grid(p, dtype=np.dtype(dtype)))
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    tx = tile_x or min(512, gx)
+    if tile_ys is None:
+        picked = sp_mod.pick_pipeline_tile(gy, k, order, width=gx)
+        tile_ys = sorted({t for t in (picked // 2, picked, picked * 2)
+                          if 0 < t <= gy})
+    cost = roofline.heat_cost(gy, gx, order=order, iters=iters, dtype=dtype)
+    shape_class = f"{gy}x{gx}/order{order}/k{k}"
+
+    def build_xla():
+        def runner():
+            return run_heat(jnp.asarray(u0), iters, order, p.xcfl, p.ycfl)
+        runner()  # warm: compile lands outside the timed region
+        return runner
+
+    def build_pipeline(ty):
+        def builder():
+            def runner():
+                # BOTH tile knobs pinned, so run_heat_resilient never
+                # consults the very cache this search is filling
+                res = sp_mod.run_heat_resilient(
+                    jnp.asarray(u0), iters, order, p.xcfl, p.ycfl, p.bc,
+                    k=k, tile_y=ty, tile_x=tx, interpret=interpret)
+                return res.value
+            runner()  # warm: compile + conformance probe outside timing
+            return runner
+        return builder
+
+    def gate(ty):
+        # rung-level probe (pipeline vs XLA, bitwise) via the stencil
+        # module's own conformance gate — keyed per order×k, so the
+        # whole tile ladder shares one verdict and a wrong: fault on
+        # the probe vetoes every pipeline candidate at once
+        return lambda: sp_mod._heat_conformance_gate(
+            order, k, tx, interpret)("pipeline")
+
+    cands = [Candidate("xla", {}, build_xla)]
+    for ty in tile_ys:
+        cands.append(Candidate(
+            f"pipeline/ty{ty}/tx{tx}", {"tile_y": int(ty), "tile_x": int(tx)},
+            build_pipeline(int(ty)), gate(int(ty))))
+    return TuneSpace("heat", shape_class, np.dtype(dtype).name,
+                     tuple(cands), cost)
+
+
+def _sort_space(n: int = 1 << 20, dtype: str = "uint32",
+                kernels=("lax", "radix", "bitonic")) -> TuneSpace:
+    """sort: radix vs bitonic vs the ``lax.sort`` baseline at one size —
+    the crossover data ``sort_auto``'s dispatch consumes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import conformance, programs
+    # NOT ``from ..ops import sort``: the package re-exports the sort
+    # *function* under that name, shadowing the submodule attribute
+    from ..ops.sort import bitonic_sort, radix_sort
+    from ..ops.sort import sort as lax_sort
+
+    nc = programs.canonical_size(n)
+    rng = np.random.default_rng(0)
+    keys_host = rng.integers(0, 2 ** 32, nc, dtype=np.uint32)
+    keys = jnp.asarray(keys_host)
+    pn = min(nc, 4096)
+    probe_host = keys_host[:pn]
+    probe = jnp.asarray(probe_host)
+    probe_ref = np.sort(probe_host)
+    fns = {"lax": lambda ks: lax_sort(ks),
+           "radix": lambda ks: radix_sort(ks),
+           "bitonic": lambda ks: bitonic_sort(ks)}
+
+    def program(kernel):
+        def build():
+            return fns[kernel]
+
+        def warm(fn):
+            jax.block_until_ready(fn(jnp.zeros(nc, jnp.uint32)))
+
+        return programs.get("sort", kernel, f"n{nc}", build,
+                            dtype="uint32", warm=warm)
+
+    def gate(kernel):
+        if kernel == "lax":
+            return None  # the reference rung
+        return lambda: conformance.check(
+            "sort", kernel, shape_class=f"n{pn}",
+            candidate=lambda: np.asarray(fns[kernel](probe)),
+            reference=lambda: probe_ref).ok
+
+    cands = []
+    for kernel in kernels:
+        kind = "radix" if kernel == "radix" else "merge"
+        cands.append(Candidate(
+            kernel, {"kernel": kernel},
+            (lambda kn: lambda: (lambda fn: (lambda: fn(keys)))(
+                program(kn)))(kernel),
+            gate(kernel),
+            cost=roofline.sort_cost(nc, kind=kind)))
+    return TuneSpace("sort", f"n{nc}", "uint32", tuple(cands))
+
+
+def _serve_space(mix_op: str = "spmv", widths=SERVE_WIDTHS,
+                 max_batch: int = 8, seed: int = 0) -> TuneSpace:
+    """serve: batch width per bucket — each width w runs a w-wide batch
+    through the op's adapter (scored per request), gated on lane 0 being
+    bitwise-equal to the width-1 solve (the vmap-batching contract)."""
+    from ..core import conformance
+    from ..serve import loadgen
+    from ..serve.workloads import ADAPTERS
+
+    spec = loadgen.build_mix(mix_op, requests=1, seed=seed)[0]
+    adapter = ADAPTERS[spec.op]
+    payload = spec.payload
+    shape_class = adapter.shape_class(payload)
+    rung = adapter.rungs()[0]
+    op = f"serve.{adapter.op}"
+
+    def gate(w):
+        if w == 1:
+            return None  # the reference width
+        return lambda: conformance.check(
+            op, f"b{w}", shape_class=shape_class,
+            candidate=lambda: np.asarray(
+                adapter.run_batch([payload] * w, rung)[0]),
+            reference=lambda: np.asarray(
+                adapter.run_batch([payload], rung)[0])).ok
+
+    def build(w):
+        def builder():
+            batch = [payload] * w
+            runner = lambda: adapter.run_batch(batch, rung)[0]
+            runner()  # warm: the batch program compiles outside timing
+            return runner
+        return builder
+
+    cands = [Candidate(f"b{w}", {"max_batch": int(w)}, build(w), gate(w),
+                       scale=float(w))
+             for w in widths if 1 <= w <= max_batch]
+    return TuneSpace(op, shape_class, "float32", tuple(cands))
+
+
+#: op name -> space builder; ``run`` routes here.  ``serve.<mix-op>``
+#: names route through the serve builder (e.g. ``serve.spmv``).
+SPACES = {
+    "spmv_scan": _spmv_space,
+    "segmented_scan": _crossover_space,
+    "heat": _heat_space,
+    "sort": _sort_space,
+}
+
+
+def build_space(op: str, **kw) -> TuneSpace:
+    """The registered candidate space for ``op`` (``serve.<mix-op>``
+    routes to the serve-width builder)."""
+    if op.startswith("serve."):
+        return _serve_space(op.split(".", 1)[1], **kw)
+    if op not in SPACES:
+        raise TuneError(f"no candidate space registered for {op!r} "
+                        f"(have {sorted(SPACES)} + serve.<op>)")
+    return SPACES[op](**kw)
+
+
+def run(op: str, *, clock: Clock | None = None, runs: int = TRIAL_RUNS,
+        persist: bool = True, **kw) -> dict:
+    """Search ``op``'s candidate space and persist the winner."""
+    return run_space(build_space(op, **kw), clock=clock, runs=runs,
+                     persist=persist)

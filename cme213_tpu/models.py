@@ -83,6 +83,12 @@ def _serve(argv: list[str]) -> int:
     return serve.main(argv)
 
 
+def _tune(argv: list[str]) -> int:
+    from . import tune_cli
+
+    return tune_cli.main(argv)
+
+
 WORKLOADS: dict[str, Workload] = {
     w.name: w
     for w in (
@@ -112,6 +118,13 @@ WORKLOADS: dict[str, Workload] = {
                  "batching front end with synthetic load, print an SLO "
                  "report; warmup: pre-compile the canonical serving "
                  "buckets for warm starts", _serve),
+        # not a reference workload: the offline search that replaces the
+        # reference's hand-tuned constants (hw2 tile shapes, hw_final
+        # warp-scan sizing) with measured winners dispatch consumes
+        Workload("tune", "autotune", "run: conformance-gate and time each "
+                 "op's registered candidate configs, persist winners to "
+                 "CME213_TUNE_CACHE; show | clear the cached winners",
+                 _tune),
     )
 }
 

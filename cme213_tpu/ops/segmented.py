@@ -49,12 +49,34 @@ def segment_ids_from_starts(seg_starts: jnp.ndarray, n: int) -> jnp.ndarray:
 # sweep moves n·log2(n) elements through HBM per scan while the blocked
 # form moves ~3n (local cumsum pass + tiny carry scan + broadcast-add).
 # 2^16 sits well under the 1M crossover the bench sweep demonstrates while
-# keeping every existing small-shape test on the bitwise flat path.
+# keeping every existing small-shape test on the bitwise flat path.  This
+# is the DEFAULT: the auto dispatch consults the tuning cache first
+# (``scan_threshold`` / ``core/tune.py``), so a measured crossover for
+# this device overrides it and ``CME213_TUNE=0`` restores it.
 BLOCKED_SCAN_THRESHOLD = 1 << 16
 # Per-block extent of the blocked decomposition.  Large enough that the
 # inter-block carry scan (n / BLOCK elements, still log-sweep) is noise,
 # small enough that a block's running cumsum stays cache/VMEM resident.
 DEFAULT_SCAN_BLOCK = 4096
+
+
+def scan_threshold() -> int:
+    """The flat/blocked crossover the auto dispatch uses: the measured
+    winner for this device (``core/tune.py``, op ``segmented_scan``,
+    shape class ``crossover``) when one is cached, else the built-in
+    ``BLOCKED_SCAN_THRESHOLD``.  Read at trace time — array lengths are
+    static under jit, so the consult costs nothing per element and each
+    shape still compiles exactly one kernel."""
+    from ..core import tune
+
+    rec = tune.lookup("segmented_scan", "crossover")
+    if rec is not None:
+        try:
+            return int(rec["statics"].get("threshold",
+                                          BLOCKED_SCAN_THRESHOLD))
+        except (TypeError, ValueError):
+            pass  # malformed cache entry: the default must keep serving
+    return BLOCKED_SCAN_THRESHOLD
 
 
 def segmented_scan_flat(values: jnp.ndarray,
@@ -158,17 +180,21 @@ def segmented_scan_blocked(values: jnp.ndarray, head_flags: jnp.ndarray,
     return out.reshape(padded)[:n]
 
 
-def segmented_scan(values: jnp.ndarray, head_flags: jnp.ndarray) -> jnp.ndarray:
+def segmented_scan(values: jnp.ndarray, head_flags: jnp.ndarray, *,
+                   block_size: int | None = None) -> jnp.ndarray:
     """Inclusive segmented sum scan — auto-dispatching entry point.
 
-    Small arrays (n < ``BLOCKED_SCAN_THRESHOLD``) run the flat log-sweep
-    (``segmented_scan_flat``, bitwise-stable with prior releases); larger
-    arrays run the blocked O(n) form (``segmented_scan_blocked``).  The
-    length is static under jit, so the dispatch costs nothing at trace
-    time and each shape compiles exactly one kernel.
+    Small arrays (n < ``scan_threshold()`` — tuned-or-default crossover)
+    run the flat log-sweep (``segmented_scan_flat``, bitwise-stable with
+    prior releases); larger arrays run the blocked O(n) form
+    (``segmented_scan_blocked``), at ``block_size`` when the caller (or
+    the tuner, via ``apps.spmv_scan``) pins one.  The length is static
+    under jit, so the dispatch costs nothing at trace time and each
+    shape compiles exactly one kernel.
     """
-    if values.shape[0] >= BLOCKED_SCAN_THRESHOLD:
-        return segmented_scan_blocked(values, head_flags)
+    if values.shape[0] >= scan_threshold():
+        return segmented_scan_blocked(values, head_flags,
+                                      block_size or DEFAULT_SCAN_BLOCK)
     return segmented_scan_flat(values, head_flags)
 
 

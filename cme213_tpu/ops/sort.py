@@ -35,6 +35,32 @@ def sort(keys: jnp.ndarray) -> jnp.ndarray:
     return lax.sort(keys)
 
 
+def sort_auto(keys: jnp.ndarray) -> jnp.ndarray:
+    """Sort via the measured winner for this device and size.
+
+    Consults the tuning cache (``core/tune.py``, op ``sort``, shape class
+    ``n<canonical>``) for the kernel the last ``tune run`` crowned —
+    ``lax`` (the library path), ``radix``, or ``bitonic`` — and falls
+    back to ``lax.sort`` with no cached winner or ``CME213_TUNE=0``.
+    The dispatch happens at trace time (lengths are static under jit),
+    so each shape still compiles exactly one kernel."""
+    from ..core import programs, tune
+
+    rec = tune.lookup("sort", f"n{programs.canonical_size(keys.shape[0])}",
+                      str(keys.dtype))
+    kernel = "lax"
+    if rec is not None:
+        try:
+            kernel = str(rec["statics"].get("kernel", "lax"))
+        except (TypeError, AttributeError):
+            kernel = "lax"
+    if kernel == "radix" and keys.dtype == jnp.uint32:
+        return radix_sort(keys)
+    if kernel == "bitonic":
+        return bitonic_sort(keys)
+    return sort(keys)
+
+
 def sort_pairs(keys: jnp.ndarray, values: jnp.ndarray):
     return lax.sort((keys, values), num_keys=1)
 

@@ -310,7 +310,7 @@ def _heat_conformance_gate(order: int, k: int, tile_x: int, interpret: bool):
 
 def run_heat_resilient(u, iters: int, order: int, xcfl, ycfl,
                        bc: tuple[float, float, float, float], k: int = 1,
-                       tile_y: int | None = None, tile_x: int = 512,
+                       tile_y: int | None = None, tile_x: int | None = None,
                        interpret: bool = False, timer=None,
                        phase_label: str = "gpu computation shared",
                        conformance: bool = True):
@@ -355,11 +355,24 @@ def run_heat_resilient(u, iters: int, order: int, xcfl, ycfl,
     b = BORDER_FOR_ORDER[order]
     kpad = _ceil_to(k * b, SUBLANE)
     gy, gx = u.shape
+    shape_class = f"{gy}x{gx}/order{order}/k{k}"
+    if tile_y is None or tile_x is None:
+        # tile knobs the caller left open resolve tuned-or-default
+        # (core/tune.py, keyed by this shape class); an empty cache or
+        # CME213_TUNE=0 leaves pick_pipeline_tile/512 in charge
+        from ..core import tune
+
+        # only the knobs the caller left open are declared, so a tuned
+        # entry can never stomp an explicitly pinned tile
+        open_knobs = {kn: None for kn, v in
+                      (("tile_y", tile_y), ("tile_x", tile_x)) if v is None}
+        t = tune.resolve("heat", shape_class, str(u.dtype), **open_knobs)
+        tile_y = t.get("tile_y", tile_y)
+        tile_x = t.get("tile_x", tile_x)
+    tile_x = tile_x or 512
     ty = tile_y or pick_pipeline_tile(gy, k, order, width=gx)
     timer = timer or PhaseTimer()
     u_host = jax.device_get(u)  # rungs donate; each attempt re-uploads
-
-    shape_class = f"{gy}x{gx}/order{order}/k{k}"
     from ..core.roofline import heat_cost
 
     cost = heat_cost(gy, gx, order=order, iters=iters, dtype=u_host.dtype)

@@ -21,7 +21,7 @@ from .request import (  # noqa: F401
     SolveRequest,
     SolveResult,
 )
-from .server import BoundedQueue, Server  # noqa: F401
+from .server import BoundedQueue, Server, tuned_batch_cap  # noqa: F401
 from .slo import Objective, SLOMonitor  # noqa: F401
 from .workloads import ADAPTERS, CipherRequest  # noqa: F401
 

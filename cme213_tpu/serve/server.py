@@ -71,6 +71,22 @@ from .request import (
 from .workloads import ADAPTERS
 
 
+def tuned_batch_cap(op: str, shape_class: str, default: int) -> int:
+    """Batch width for one (op, shape-class) bucket: the measured winner
+    from the tuning cache (``core/tune.py``, op ``serve.<op>``) when one
+    is cached, else ``default`` (the server's ``max_batch``).  Never
+    *raises* the cap past ``default`` — the queue/SLO sizing assumed it."""
+    from ..core import tune
+
+    resolved = tune.resolve(f"serve.{op}", shape_class, "float32",
+                            max_batch=default)
+    try:
+        cap = int(resolved["max_batch"])
+    except (KeyError, TypeError, ValueError):
+        return default
+    return max(1, min(cap, default))
+
+
 class BoundedQueue:
     """FIFO with a hard capacity: ``push`` refuses (returns False) at
     capacity instead of growing — the arrival being refused is the
@@ -132,6 +148,7 @@ class Server:
         self.slo = slo                  # serve.slo.SLOMonitor | None
         self._rids = itertools.count()
         self._admit_cache: dict[tuple, int] = {}
+        self._tuned_caps: dict[tuple, int] = {}
 
     # ------------------------------------------------------------ submit
 
@@ -212,7 +229,11 @@ class Server:
         batch = [r for r in self.queue.items()
                  if r.op == head.op
                  and adapter.shape_class(r.payload, coarse=coarse) == key]
-        batch = batch[:self.max_batch]
+        cap = self._tuned_caps.get((head.op, key))
+        if cap is None:
+            cap = tuned_batch_cap(head.op, key, self.max_batch)
+            self._tuned_caps[(head.op, key)] = cap
+        batch = batch[:cap]
 
         dequeued = self.clock.now()
         for r in batch:

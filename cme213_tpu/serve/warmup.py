@@ -27,14 +27,17 @@ from ..core import metrics
 
 
 def warm_buckets(mix: str, requests: int = 12, max_batch: int = 8,
-                 seed: int = 0) -> list[str]:
+                 seed: int = 0, tuned: bool = False) -> list[str]:
     """Run one batch per (op, shape class, batch width, rung) of the
     mix's canonical buckets through the adapters — compiling each program
     into the process cache and (if enabled) the persistent disk cache.
     Batch widths 1 and ``max_batch`` are warmed: the widths a drained
-    tail and a full batch window actually dispatch.  Returns the warmed
-    ``op[class]/bN`` labels."""
+    tail and a full batch window actually dispatch.  With ``tuned``, the
+    tuning cache's per-bucket batch width (``server.tuned_batch_cap``) is
+    warmed too — the width a tuned server will actually form.  Returns
+    the warmed ``op[class]/bN`` labels."""
     from .loadgen import build_mix
+    from .server import tuned_batch_cap
     from .workloads import ADAPTERS
 
     specs = build_mix(mix, requests, seed=seed)
@@ -47,7 +50,10 @@ def warm_buckets(mix: str, requests: int = 12, max_batch: int = 8,
     warmed = []
     for (op, sc), payloads in sorted(groups.items()):
         adapter = ADAPTERS[op]
-        for b in sorted({1, max(1, max_batch)}):
+        widths = {1, max(1, max_batch)}
+        if tuned:
+            widths.add(tuned_batch_cap(op, sc, max(1, max_batch)))
+        for b in sorted(widths):
             batch = (payloads * b)[:b]
             ok = True
             for rung in adapter.rungs():
@@ -75,6 +81,9 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--max-batch", type=int, default=8,
                     help="full batch width to warm (width 1 always is)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tuned", action="store_true",
+                    help="also warm each bucket's tuned batch width "
+                         "(from the CME213_TUNE_CACHE winners)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
@@ -86,7 +95,8 @@ def main(argv: list[str]) -> int:
     cache_dir = os.environ.get("CME213_COMPILE_CACHE")
     before = metrics.snapshot()
     warmed = warm_buckets(args.mix, requests=args.requests,
-                          max_batch=args.max_batch, seed=args.seed)
+                          max_batch=args.max_batch, seed=args.seed,
+                          tuned=args.tuned)
     report = {
         "warmed": warmed,
         "programs": programs.size(),

@@ -441,6 +441,33 @@ def summarize(events: list[dict], out=None) -> dict:
         for e in oks:
             w(f"  ok {e.get('objective')}: short {e.get('burn_short')}\n")
 
+    # autotuning (core/tune.py): search activity + the tuned-vs-default
+    # split at dispatch — the "is the cache actually consulted" signal
+    tuning = None
+    t_trials = [e for e in events if e["event"] == "tune-trial"]
+    t_winners = [e for e in events if e["event"] == "tune-winner"]
+    t_hits = sum(1 for e in events if e["event"] == "tune-hit")
+    t_defaults = sum(1 for e in events if e["event"] == "tune-default")
+    if t_trials or t_winners or t_hits or t_defaults:
+        tuning = {
+            "trials": len(t_trials),
+            "rejected": sum(1 for e in t_trials if not e.get("ok")),
+            "winners": {
+                f"{e.get('op')} [{e.get('shape_class')}]": {
+                    "candidate": e.get("candidate"),
+                    "statics": e.get("statics"),
+                    "gbs": e.get("gbs"),
+                } for e in t_winners},
+            "hits": t_hits,
+            "defaults": t_defaults,
+        }
+        w(f"tuning: {len(t_trials)} trial(s) "
+          f"({tuning['rejected']} rejected), {len(t_winners)} winner(s); "
+          f"dispatch {t_hits} tuned / {t_defaults} default\n")
+        for key, rec in sorted(tuning["winners"].items()):
+            w(f"  {key}: {rec['candidate']} {rec['statics']} "
+              f"{rec['gbs']} GB/s\n")
+
     counts = Counter(e["event"] for e in events)
     for label, ev in (("op failures", "op-failure"),
                       ("retries", "retry"),
@@ -485,6 +512,7 @@ def summarize(events: list[dict], out=None) -> dict:
             "phases": phases,
             "tenants": tenants,
             "slo": slo,
+            "tuning": tuning,
             "counts": dict(counts)}
 
 

@@ -1,0 +1,422 @@
+"""The autotuner (core/tune.py): gate-then-time search, persistent
+winner cache, dispatch consumption, and the kill-switch.
+
+The load-bearing contracts:
+
+- a candidate whose conformance probe is poisoned (``wrong:<op>`` fault)
+  is excluded BEFORE timing and can never win;
+- winners persist across processes (``CME213_TUNE_CACHE``) and a fresh
+  process's dispatch resolves statics from the cache — observable as a
+  ``tune-hit`` event — without a single retrace;
+- ``CME213_TUNE=0`` restores every built-in default without touching
+  the cache;
+- exact ties break deterministically to the first-registered candidate
+  (scripted clock, so the tie is exact by construction).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cme213_tpu.core import conformance, faults, metrics, trace, tune
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    monkeypatch.delenv(tune.CACHE_ENV, raising=False)
+    monkeypatch.delenv(tune.KILL_ENV, raising=False)
+    monkeypatch.delenv("CME213_CONFORMANCE_CACHE", raising=False)
+    trace.flush_sink()
+    trace.clear_events()
+    metrics.reset()
+    tune.reset()
+    conformance.reset()
+    yield
+    trace.flush_sink()
+    trace.clear_events()
+    metrics.reset()
+    tune.reset()
+    conformance.reset()
+
+
+# ---------------------------------------------------------- cache unit
+
+def test_store_lookup_resolve_roundtrip():
+    tune.store("toy", "n64", "float32", statics={"block": 8},
+               candidate="b8", ms=1.0, gbs=2.0)
+    rec = tune.lookup("toy", "n64")
+    assert rec["statics"] == {"block": 8} and rec["candidate"] == "b8"
+    # resolve() is restricted to declared defaults: stale statics a call
+    # site doesn't understand can never leak in
+    out = tune.resolve("toy", "n64", "float32", block=1, other=0)
+    assert out == {"block": 8, "other": 0}
+    events = [e for e in trace.events() if e["event"] == "tune-hit"]
+    assert events and json.loads(events[0]["statics"]) == {"block": 8}
+
+
+def test_resolve_default_when_empty():
+    out = tune.resolve("toy", "n64", "float32", block=4)
+    assert out == {"block": 4}
+    assert any(e["event"] == "tune-default" for e in trace.events())
+
+
+def test_disk_cache_persist_and_reload(tmp_path, monkeypatch):
+    path = tmp_path / "tune.json"
+    monkeypatch.setenv(tune.CACHE_ENV, str(path))
+    tune.store("toy", "n64", "float32", statics={"block": 8},
+               candidate="b8", ms=1.0, gbs=2.0)
+    assert path.exists()
+    tune.reset()   # drop in-process state: the next lookup re-reads disk
+    assert tune.lookup("toy", "n64")["statics"] == {"block": 8}
+
+
+def test_corrupt_disk_cache_serves_defaults(tmp_path, monkeypatch):
+    path = tmp_path / "tune.json"
+    path.write_text("{not json")
+    monkeypatch.setenv(tune.CACHE_ENV, str(path))
+    assert tune.lookup("toy", "n64") is None
+
+
+def test_clear_removes_disk_and_memory(tmp_path, monkeypatch):
+    path = tmp_path / "tune.json"
+    monkeypatch.setenv(tune.CACHE_ENV, str(path))
+    tune.store("toy", "n64", "float32", statics={}, candidate="x",
+               ms=1.0, gbs=0.0)
+    assert tune.clear() == 1
+    assert not path.exists()
+    assert tune.lookup("toy", "n64") is None
+
+
+# ---------------------------------------------------------- kill-switch
+
+def test_kill_switch_restores_defaults(monkeypatch):
+    from cme213_tpu.ops import segmented
+
+    tune.store("segmented_scan", "crossover", "float32",
+               statics={"threshold": 123}, candidate="thr123",
+               ms=1.0, gbs=1.0)
+    assert segmented.scan_threshold() == 123
+    monkeypatch.setenv(tune.KILL_ENV, "0")
+    assert segmented.scan_threshold() == segmented.BLOCKED_SCAN_THRESHOLD
+    assert tune.lookup("segmented_scan", "crossover") is None
+    assert tune.resolve("toy", "n64", "float32", block=4) == {"block": 4}
+    # flipping the switch back re-enables the same cached winner
+    monkeypatch.setenv(tune.KILL_ENV, "1")
+    assert segmented.scan_threshold() == 123
+
+
+# ------------------------------------------------ gate-then-time search
+
+def _toy_candidate(label, statics=None, gate=None, runner=None):
+    runner = runner or (lambda: None)
+    return tune.Candidate(label, statics if statics is not None
+                          else {"which": label}, lambda: runner, gate)
+
+
+class ScriptClock:
+    """now() advances a fixed quantum per call: every candidate measures
+    the identical duration, so ties are exact by construction."""
+
+    def __init__(self, step_s: float = 0.001):
+        self.t = 0.0
+        self.step = step_s
+
+    def now(self) -> float:
+        self.t += self.step
+        return self.t
+
+    def sleep(self, seconds: float) -> None:
+        self.t += seconds
+
+
+def test_tie_breaks_to_first_registered():
+    clock = ScriptClock()
+    space = tune.TuneSpace("toy", "sc", "float32",
+                           (_toy_candidate("a"), _toy_candidate("b")))
+    rep = tune.run_space(space, clock=clock, runs=3, persist=False)
+    assert rep["winner"]["candidate"] == "a"
+    # same measurements, reversed registration order: the OTHER one wins
+    # — proof the tie-break is registration order, not timing noise
+    space_r = tune.TuneSpace("toy", "sc", "float32",
+                             (_toy_candidate("b"), _toy_candidate("a")))
+    rep_r = tune.run_space(space_r, clock=ScriptClock(), runs=3,
+                           persist=False)
+    assert rep_r["winner"]["candidate"] == "b"
+
+
+def test_gated_out_candidate_cannot_win():
+    clock = ScriptClock()
+    space = tune.TuneSpace("toy", "sc", "float32", (
+        _toy_candidate("bad", gate=lambda: False),
+        _toy_candidate("good"),
+    ))
+    rep = tune.run_space(space, clock=clock, runs=2, persist=False)
+    assert rep["winner"]["candidate"] == "good"
+    bad = [t for t in rep["trials"] if t["candidate"] == "bad"]
+    assert bad and not bad[0]["ok"]
+    assert metrics.counter("tune.rejected").value == 1
+
+
+def test_dying_probe_is_a_veto_not_a_crash():
+    def boom():
+        raise RuntimeError("probe died")
+
+    space = tune.TuneSpace("toy", "sc", "float32", (
+        _toy_candidate("bad", gate=boom),
+        _toy_candidate("good"),
+    ))
+    rep = tune.run_space(space, clock=ScriptClock(), runs=2, persist=False)
+    assert rep["winner"]["candidate"] == "good"
+
+
+def test_no_survivor_raises_tune_error():
+    space = tune.TuneSpace("toy", "sc", "float32",
+                           (_toy_candidate("bad", gate=lambda: False),))
+    with pytest.raises(tune.TuneError):
+        tune.run_space(space, clock=ScriptClock(), runs=1, persist=False)
+
+
+def test_wrong_fault_candidate_is_excluded_before_timing():
+    """A ``wrong:spmv_scan``-poisoned conformance probe must exclude
+    exactly the first gated candidate — it never reaches timing and can
+    never win, however fast it would have measured."""
+    with faults.injected("wrong:spmv_scan"):
+        conformance.reset()   # no cached verdict may mask the fault
+        rep = tune.run("spmv_scan", n=2048, iters=2, runs=2, persist=False,
+                       block_sizes=(512, 1024))
+    # flat is the ungated reference; the first gated candidate is
+    # blocked/bs512, whose probe the fault perturbed
+    bad = [t for t in rep["trials"] if t["candidate"] == "blocked/bs512"]
+    assert bad and not bad[0]["ok"]
+    assert rep["winner"]["candidate"] != "blocked/bs512"
+    # the OTHER blocked candidate's probe ran clean and was timed
+    ok_labels = {t["candidate"] for t in rep["trials"] if t["ok"]}
+    assert "flat" in ok_labels and "blocked/bs1024" in ok_labels
+
+
+def test_winner_event_and_persist(tmp_path, monkeypatch):
+    path = tmp_path / "tune.json"
+    monkeypatch.setenv(tune.CACHE_ENV, str(path))
+    space = tune.TuneSpace("toy", "sc", "float32",
+                           (_toy_candidate("a", statics={"block": 2}),))
+    tune.run_space(space, clock=ScriptClock(), runs=2)
+    events = trace.events()
+    winners = [e for e in events if e["event"] == "tune-winner"]
+    assert winners and winners[0]["candidate"] == "a"
+    trials = [e for e in events if e["event"] == "tune-trial"]
+    assert trials and trials[0]["ok"]
+    data = json.loads(path.read_text())
+    (key,) = data.keys()
+    assert key.endswith("|toy|sc|float32")
+    assert data[key]["statics"] == {"block": 2}
+
+
+# ------------------------------------------- dispatch consumes winners
+
+def test_spmv_dispatch_resolves_tuned_kernel():
+    from cme213_tpu.apps import spmv_scan as sp
+    from cme213_tpu.core import programs
+
+    prob = sp.generate_problem(256, p=8, q=128, iters=2, seed=0)
+    bucket = f"n{programs.canonical_size(prob.n)}"
+    tune.store("spmv_scan", bucket, "float32",
+               statics={"kernel": "blocked", "block_size": 128},
+               candidate="blocked/bs128", ms=1.0, gbs=1.0)
+    out = sp.run_spmv_scan(prob, kernel="auto")
+    errs = sp.external_check(prob, out)
+    assert errs["rel_l2"] < 1e-4
+    hits = [e for e in trace.events() if e["event"] == "tune-hit"]
+    assert any(e["op"] == "spmv_scan" and e["shape_class"] == bucket
+               for e in hits)
+
+
+def test_heat_dispatch_pins_explicit_tiles_over_tuned(monkeypatch):
+    """An explicitly passed tile knob must win over a cached entry —
+    only caller-open knobs resolve from the tuner."""
+    import numpy as np
+
+    from cme213_tpu.config import SimParams
+    from cme213_tpu.grid import make_initial_grid
+    from cme213_tpu.ops.stencil_pipeline import run_heat_resilient
+
+    p = SimParams(nx=32, ny=32, order=2, iters=2)
+    u0 = make_initial_grid(p)
+    # the grid carries its halo: nx=ny=32 at order 2 is a 34x34 array
+    tune.store("heat", "34x34/order2/k1", "float32",
+               statics={"tile_y": 8, "tile_x": 32},
+               candidate="pipeline/ty8/tx32", ms=1.0, gbs=1.0)
+    res = run_heat_resilient(u0, 2, 2, p.xcfl, p.ycfl, p.bc,
+                             tile_y=16, interpret=True)
+    assert np.isfinite(np.asarray(res.value)).all()
+    # tile_y was pinned by the caller; only tile_x was open to the tuner
+    hits = [e for e in trace.events() if e["event"] == "tune-hit"]
+    assert hits and json.loads(hits[0]["statics"]) == {"tile_x": 32}
+
+
+def test_serve_batch_cap_consults_cache():
+    from cme213_tpu.serve.server import tuned_batch_cap
+
+    tune.store("serve.spmv_scan", "n64/i2", "float32",
+               statics={"max_batch": 2}, candidate="b2", ms=1.0, gbs=0.0)
+    assert tuned_batch_cap("spmv_scan", "n64/i2", 8) == 2
+    # the tuned width is a cap, never an escalation past the server's
+    assert tuned_batch_cap("spmv_scan", "n64/i2", 1) == 1
+    assert tuned_batch_cap("spmv_scan", "other", 8) == 8
+
+
+def test_sort_auto_dispatches_tuned_kernel():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from cme213_tpu.core import programs
+    from cme213_tpu.ops.sort import sort_auto
+
+    keys_host = np.random.default_rng(0).integers(
+        0, 2 ** 32, 512, dtype=np.uint32)
+    bucket = f"n{programs.canonical_size(512)}"
+    tune.store("sort", bucket, "uint32", statics={"kernel": "bitonic"},
+               candidate="bitonic", ms=1.0, gbs=1.0)
+    out = np.asarray(sort_auto(jnp.asarray(keys_host)))
+    assert (out == np.sort(keys_host)).all()
+
+
+# ----------------------------------------- cross-process acceptance run
+
+@pytest.mark.slow
+def test_subprocess_round_trip_zero_retraces(tmp_path):
+    """The acceptance path end-to-end: ``tune run`` in one process
+    persists a winner; a FRESH process's ``run_spmv_scan`` resolves its
+    statics from the disk cache (``tune-hit`` in its trace) with zero
+    retraces."""
+    cache = tmp_path / "tune.json"
+    trace_file = tmp_path / "trace.jsonl"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "CME213_TUNE_CACHE": str(cache)}
+    env.pop("CME213_TRACE_FILE", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "cme213_tpu", "tune", "run",
+         "--op", "spmv_scan", "--n", "4096", "--iters", "2",
+         "--runs", "2", "--json"],
+        env=env, cwd=REPO_ROOT, timeout=600, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    (rep,) = json.loads(r.stdout)
+    assert rep["winner"]["candidate"]
+    data = json.loads(cache.read_text())
+    assert any("|spmv_scan|n4096|" in k for k in data)
+
+    script = (
+        "from cme213_tpu.apps import spmv_scan as sp\n"
+        "from cme213_tpu.core import trace\n"
+        "prob = sp.generate_problem(4096, p=64, q=2048, iters=2, seed=0)\n"
+        "sp.run_spmv_scan(prob, kernel='auto')\n"
+        "trace.flush_sink()\n")
+    env2 = {**env, "CME213_TRACE_FILE": str(trace_file)}
+    r2 = subprocess.run([sys.executable, "-c", script], env=env2,
+                        cwd=REPO_ROOT, timeout=600, capture_output=True,
+                        text=True)
+    assert r2.returncode == 0, r2.stderr
+    events = [json.loads(line) for line in
+              trace_file.read_text().splitlines() if line.strip()]
+    hits = [e for e in events
+            if e.get("event") == "tune-hit" and e.get("op") == "spmv_scan"]
+    assert hits, "fresh process never consulted the tuning cache"
+    assert not [e for e in events if e.get("event") == "compile-retrace"]
+
+
+# --------------------------------------------------------------- sweeps
+
+def test_sort_sweep_carries_tuned_column():
+    from cme213_tpu.bench import sweeps
+
+    tune.store("sort", "n4096", "uint32", statics={"kernel": "bitonic"},
+               candidate="bitonic", ms=1.0, gbs=1.0)
+    rows = sweeps.sort_sweep(ns=(4096,), kernels=("lax", "auto"))
+    assert all(r["tuned"] == "bitonic" for r in rows)
+    assert all(r["ok"] for r in rows)
+    assert {r["kernel"] for r in rows} == {"lax", "auto"}
+
+
+def test_spmv_sweep_carries_tuned_column():
+    from cme213_tpu.bench import sweeps
+
+    tune.store("spmv_scan", "n4096", "float32",
+               statics={"kernel": "flat"}, candidate="flat",
+               ms=1.0, gbs=1.0)
+    rows = sweeps.spmv_scan_sweep(ns=(4096,), iters=2, kernels=("flat",))
+    assert rows and rows[0]["tuned"] == "flat"
+
+
+# ------------------------------------------------------------- trace CLI
+
+def test_trace_summary_tuning_section(tmp_path, monkeypatch, capsys):
+    from cme213_tpu import trace_cli
+
+    path = tmp_path / "t.jsonl"
+    monkeypatch.setenv(trace.TRACE_FILE_ENV, str(path))
+    space = tune.TuneSpace("toy", "sc", "float32",
+                           (_toy_candidate("a", statics={"block": 2}),))
+    tune.run_space(space, clock=ScriptClock(), runs=2, persist=False)
+    tune.store("toy", "sc", "float32", statics={"block": 2},
+               candidate="a", ms=1.0, gbs=0.0)
+    tune.resolve("toy", "sc", "float32", block=4)
+    trace.flush_sink()
+    monkeypatch.delenv(trace.TRACE_FILE_ENV)
+    capsys.readouterr()
+    assert trace_cli.main(["summary", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "tuning:" in out
+    assert "1 winner(s)" in out
+    assert "toy [sc]" in out
+    agg = json.loads(
+        subprocess.run([sys.executable, "-m", "cme213_tpu", "trace",
+                        "summary", "--json", str(path)],
+                       cwd=REPO_ROOT, env={**os.environ,
+                                           "JAX_PLATFORMS": "cpu"},
+                       capture_output=True, text=True, timeout=300).stdout)
+    assert agg["tuning"]["hits"] == 1
+
+
+# ------------------------------------------------------- bench retries
+
+def test_bench_retry_policy_backoff_is_deterministic():
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    from cme213_tpu.core.resilience import FailureKind, RetryPolicy
+
+    sleeps = []
+    policy = RetryPolicy(max_retries=1, base_delay_s=120.0, multiplier=1.0,
+                         max_delay_s=120.0, retry_on=(FailureKind.RUNTIME,),
+                         sleep=sleeps.append)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise bench.DeviceUnreachable("preflight device unreachable")
+        return {"ok": True}
+
+    assert policy.run(flaky, op="bench.heat2d") == {"ok": True}
+    assert sleeps == [120.0]           # deterministic, injectable backoff
+    retries = [e for e in trace.events() if e["event"] == "retry"]
+    assert retries and retries[0]["op"] == "bench.heat2d"
+
+
+def test_bench_device_unreachable_classifies_runtime():
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    from cme213_tpu.core.resilience import FailureKind, classify_failure
+
+    kind = classify_failure(bench.DeviceUnreachable("device unreachable"))
+    assert kind == FailureKind.RUNTIME

@@ -118,7 +118,8 @@ def test_workload_registry():
     from cme213_tpu.models import WORKLOADS, dispatch, usage
 
     assert set(WORKLOADS) == {"cipher", "pagerank", "heat2d", "vigenere",
-                              "sorts", "spmv_scan", "trace", "serve"}
+                              "sorts", "spmv_scan", "trace", "serve",
+                              "tune"}
     assert dispatch(["--help"]) == 0
     assert dispatch(["no-such-workload"]) == 2
     for w in WORKLOADS.values():
