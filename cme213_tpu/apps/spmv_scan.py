@@ -497,14 +497,20 @@ def _program(rung: str, n: int, iters: int, dtype, p: int | None = None,
         return _build_runner(rung, iters, interpret=interpret,
                              max_len=max_len, block_size=block_size)
 
+    def probe_args():
+        return (jnp.zeros(n, dtype), jnp.zeros(n, dtype),
+                jnp.zeros(n, jnp.int32),
+                jnp.zeros(max(1, (p or 1) - 1), jnp.int32))
+
     def warm(fn):
-        check_op(f"spmv_scan.{rung}",
-                 fn(jnp.zeros(n, dtype), jnp.zeros(n, dtype),
-                    jnp.zeros(n, jnp.int32),
-                    jnp.zeros(max(1, (p or 1) - 1), jnp.int32)))
+        check_op(f"spmv_scan.{rung}", fn(*probe_args()))
+
+    from ..core import roofline
 
     return programs.get("spmv_scan", rung, f"n{n}/i{iters}", build,
-                        dtype=np.dtype(dtype).name, warm=warm, **static)
+                        dtype=np.dtype(dtype).name, warm=warm,
+                        cost=roofline.spmv_scan_cost(n, iters, dtype=dtype),
+                        probe=probe_args, **static)
 
 
 def _bucket_gate(n_to: int, kernel: str, dtype) -> bool:

@@ -6,7 +6,7 @@ from .resilience import (FailureKind, FallbackResult, NonFiniteError,
                          with_fallback)
 from .trace import (EVENT_SCHEMA, clear_events, events, flush_sink,
                     record_event, span, validate_record)
-from . import admission, conformance, metrics, programs, roofline
+from . import admission, conformance, diag, metrics, programs, roofline
 
 __all__ = [
     "PhaseTimer",
@@ -33,6 +33,7 @@ __all__ = [
     "EVENT_SCHEMA",
     "admission",
     "conformance",
+    "diag",
     "metrics",
     "programs",
     "roofline",

@@ -162,8 +162,11 @@ def check(op: str, rung: str, shape_class: str, candidate, reference,
         metrics.counter("conformance.cache_hits").inc()
         return Verdict(hit.ok, hit.detail, cached=True)
 
-    from .faults import maybe_perturb
+    from .faults import maybe_fail_stage, maybe_perturb
 
+    # staged forensics: a `stage:<op>.<rung>:conformance` clause kills the
+    # probe here, pre-tagged, so gate-path attribution is injectable
+    maybe_fail_stage(f"{op}.{rung}", "conformance")
     start = time.perf_counter()
     out = maybe_perturb(op, candidate())
     ref = reference()

@@ -1,0 +1,531 @@
+"""Device-health doctor, staged kernel forensics, and cost attribution.
+
+The reference enforces a brutal but effective diagnostic discipline:
+``checkCudaErrors`` around every API call and ``cudaGetLastError`` after
+every launch (``hw/hw1/programming/mp1-util.h:8-18``), so a failure is
+always pinned to the exact call that caused it.  The JAX/TPU stack has no
+equivalent — an async XLA error surfaces wherever the value is first
+blocked on, a Mosaic lowering failure and a runtime crash look the same
+from a bench row, and a dead device yields nothing but a hung
+``block_until_ready``.  Five capture rounds died that way (BENCH_r02's
+opaque Pallas failures; r03–r05's "preflight: device unreachable" with
+nothing to debug).  This module is the missing layer, in three pillars:
+
+- **Device health** (:func:`health_report`): a staged probe ladder —
+  platform/device enumeration, a ``memory_stats()`` snapshot, a timed
+  micro-kernel liveness check — where every stage runs under a watchdog
+  timeout so a hung runtime yields a *report* saying which stage hung,
+  never a hung doctor.  Reports emit a schema-registered
+  ``device-health`` event, set ``diag.device.*`` gauges (picked up by
+  ``metrics.render_prometheus`` like any other gauge), and append to a
+  persistent JSONL history ring under ``CME213_DIAG_DIR`` so device decay
+  is visible across runs and restarts.
+
+- **Staged forensics** (:func:`stage_scope` / :func:`failure_stage`):
+  dispatch wraps each phase of a rung's life — ``lower`` (build),
+  ``compile`` (warm), ``execute``, ``conformance`` — and any exception is
+  tagged with the stage it escaped from (an attribute on the exception,
+  because contextvars unwind before the ladder's handler runs).
+  ``with_fallback`` carries the tag onto ``kernel-failure`` events, so
+  "Pallas rung failed" becomes "failed at lowering with Mosaic error X".
+  :func:`forensics_state` exposes the open/last-failed stage for the
+  flight recorder.
+
+- **Predicted-vs-measured attribution** (:func:`check_attribution`):
+  cross-checks ``compiled.cost_analysis()`` flops/bytes against the
+  ``core/roofline.py`` model a bench row will be graded with, emitting
+  ``attribution-mismatch`` beyond a tolerance (``CME213_DIAG_TOL``,
+  default ratio 2.0) — the guard that keeps published ``pct_peak``
+  numbers honest.  Dispatch-time checks are opt-in
+  (``CME213_DIAG_ATTRIBUTION=1``) because lowering twice is not free;
+  ``doctor calibrate`` always runs them.
+
+CLI: ``python -m cme213_tpu doctor [--json]`` and ``doctor calibrate``
+(``doctor_cli.py``).  This module imports only stdlib + sibling leaf
+modules (``metrics``, ``trace``, lazily ``faults``/``platform``/jax), so
+the resilience and program-cache layers can import it without cycles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+#: directory for the persistent health-history ring (unset = no ring)
+DIAG_DIR_ENV = "CME213_DIAG_DIR"
+#: truthy = run predicted-vs-measured checks at program-cache build time
+ATTRIBUTION_ENV = "CME213_DIAG_ATTRIBUTION"
+#: mismatch tolerance as a measured/predicted ratio (default 2.0)
+TOLERANCE_ENV = "CME213_DIAG_TOL"
+#: per-stage watchdog budget for health probes, seconds
+TIMEOUT_ENV = "CME213_DOCTOR_TIMEOUT_S"
+
+RING_NAME = "health-ring.jsonl"
+RING_CAP = 256
+
+#: the dispatch stages forensics attributes failures to, in ladder order
+STAGES = ("lower", "compile", "execute", "conformance")
+
+#: attribute carried on exceptions (contextvars unwind before the
+#: ladder's handler runs, so the tag must travel WITH the exception)
+STAGE_ATTR = "_cme213_stage"
+
+_LOCK = threading.Lock()
+_LAST_HEALTH: dict | None = None
+_OPEN_STAGE: dict | None = None
+_LAST_FAILED_STAGE: dict | None = None
+_ATTRIBUTION: list = []
+
+# message fragments that identify the earlier stages when an exception
+# carries no explicit tag (same family as resilience._COMPILE_MARKERS,
+# split by stage: Mosaic/MLIR noise means lowering died; vmem exhaustion
+# and generic compile errors mean codegen died)
+_LOWER_MARKERS = ("mosaic", "mlir", "lowering", "unsupported",
+                  "unimplemented")
+_COMPILE_MARKERS = ("compil", "vmem")
+
+
+# --------------------------------------------------------- staged forensics
+
+def mark_stage(exc: BaseException, stage: str) -> BaseException:
+    """Tag ``exc`` with the dispatch stage it escaped from (first tag
+    wins — the innermost scope knows best)."""
+    if getattr(exc, STAGE_ATTR, None) is None:
+        try:
+            setattr(exc, STAGE_ATTR, stage)
+        except Exception:  # noqa: BLE001 — slotted exceptions: heuristics
+            pass           # in failure_stage still apply
+    return exc
+
+
+def _tagged_stage(exc: BaseException) -> str | None:
+    """Explicit stage tag on ``exc`` or anything in its cause chain."""
+    seen = set()
+    cur: BaseException | None = exc
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        s = getattr(cur, STAGE_ATTR, None)
+        if s:
+            return s
+        cur = cur.__cause__ or cur.__context__
+    return None
+
+
+def failure_stage(exc: BaseException, default: str = "execute") -> str:
+    """Which dispatch stage ``exc`` belongs to: the explicit tag when one
+    was attached (a ``compile``-tagged error whose message screams Mosaic
+    is refined to ``lower`` — warmup is where lazily-built kernels really
+    lower), else message heuristics, else ``default``."""
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    tagged = _tagged_stage(exc)
+    if tagged == "compile" and any(m in msg for m in _LOWER_MARKERS):
+        return "lower"
+    if tagged:
+        return tagged
+    return stage_for_message(msg, default=default)
+
+
+def stage_for_message(message: str, default: str = "execute") -> str:
+    """Stage heuristics over bare error text (for failure rows that cross
+    a process boundary, where the exception object is gone)."""
+    msg = str(message).lower()
+    if any(m in msg for m in _LOWER_MARKERS):
+        return "lower"
+    if any(m in msg for m in _COMPILE_MARKERS):
+        return "compile"
+    if "conformance" in msg:
+        return "conformance"
+    return default if default in STAGES else "execute"
+
+
+@contextmanager
+def stage_scope(op: str, stage: str):
+    """Attribute any exception escaping the body to ``(op, stage)`` and
+    track it as the open forensics stage (embedded in flight dumps)."""
+    global _OPEN_STAGE, _LAST_FAILED_STAGE
+    prev = _OPEN_STAGE
+    frame = {"op": op, "stage": stage, "t": round(time.time(), 6)}
+    _OPEN_STAGE = frame
+    try:
+        yield
+    except BaseException as e:
+        mark_stage(e, stage)
+        with _LOCK:
+            _LAST_FAILED_STAGE = dict(frame, error=type(e).__name__)
+        raise
+    finally:
+        _OPEN_STAGE = prev
+
+
+def forensics_state() -> dict:
+    """Open and last-failed stage frames (both None when quiet) — the
+    flight recorder embeds this so a crash dump says what was in flight."""
+    with _LOCK:
+        return {"open": dict(_OPEN_STAGE) if _OPEN_STAGE else None,
+                "last_failed": (dict(_LAST_FAILED_STAGE)
+                                if _LAST_FAILED_STAGE else None)}
+
+
+# ------------------------------------------------------- health probe ladder
+
+def _run_stage(name: str, fn, timeout_s: float) -> dict:
+    """Run one probe under a watchdog: a daemon thread does the work, the
+    caller waits at most ``timeout_s`` — a hung runtime becomes a
+    ``timed_out`` stage row instead of a hung doctor."""
+    done = threading.Event()
+    result: dict = {}
+
+    def runner():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — reported, not raised
+            result["error"] = f"{type(e).__name__}: {e}"[:300]
+        finally:
+            done.set()
+
+    t0 = time.perf_counter()
+    threading.Thread(target=runner, daemon=True,
+                     name=f"diag-{name}").start()
+    finished = done.wait(timeout_s)
+    row = {"stage": name, "ok": False,
+           "ms": round((time.perf_counter() - t0) * 1e3, 3)}
+    if not finished:
+        row["timed_out"] = True
+        row["detail"] = f"no response within {timeout_s}s"
+    elif "error" in result:
+        row["detail"] = result["error"]
+    else:
+        row["ok"] = True
+        row["detail"] = result.get("value")
+    return row
+
+
+def _probe_enumerate() -> dict:
+    from .platform import apply_platform_env
+    apply_platform_env()
+    import jax
+
+    devs = jax.devices()
+    return {"platform": devs[0].platform, "device_count": len(devs),
+            "devices": [{"id": d.id,
+                         "kind": getattr(d, "device_kind", "") or d.platform,
+                         "process_index": getattr(d, "process_index", 0)}
+                        for d in devs]}
+
+
+def _probe_memory() -> dict:
+    import jax
+
+    out = {}
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — CPU backends often lack this
+            stats = None
+        if stats:
+            out[str(d.id)] = {k: stats[k] for k in
+                              ("bytes_in_use", "bytes_limit",
+                               "peak_bytes_in_use") if k in stats}
+    return out if out else {"unavailable": True}
+
+
+def _probe_liveness() -> dict:
+    from .faults import InjectedFault, maybe_unreachable
+    if maybe_unreachable("diag.liveness"):
+        raise InjectedFault("injected: device unreachable")
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    (jnp.ones((8, 8)) * 2 + 1).block_until_ready()
+    return {"probe_ms": round((time.perf_counter() - t0) * 1e3, 3)}
+
+
+def health_report(timeout_s: float | None = None, ring: bool = True) -> dict:
+    """Run the staged health ladder and return a JSON-able report.
+
+    Stages run in order; ``memory`` is advisory (CPU backends have no
+    ``memory_stats``), so ``healthy`` is ``enumerate ok AND liveness ok``.
+    Side effects: a ``device-health`` event, ``diag.device.*`` gauges, the
+    module-level last-health snapshot (embedded in flight dumps), and —
+    when ``CME213_DIAG_DIR`` is set and ``ring`` — one appended line in
+    the persistent history ring.
+    """
+    from .metrics import gauge
+    from .trace import record_event
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get(TIMEOUT_ENV, "30") or 30)
+
+    stages = [_run_stage("enumerate", _probe_enumerate, timeout_s)]
+    enum_ok = stages[0]["ok"]
+    enum_detail = stages[0]["detail"] if enum_ok else {}
+    if enum_ok:
+        stages.append(_run_stage("memory", _probe_memory, timeout_s))
+        stages.append(_run_stage("liveness", _probe_liveness, timeout_s))
+    by_name = {s["stage"]: s for s in stages}
+    live = by_name.get("liveness", {"ok": False})
+    healthy = bool(enum_ok and live["ok"])
+    probe_ms = (live.get("detail") or {}).get("probe_ms") if live["ok"] \
+        else None
+    platform = enum_detail.get("platform") if enum_ok else None
+    device_count = enum_detail.get("device_count", 0) if enum_ok else 0
+
+    report = {
+        "doctor": 1,
+        "t": round(time.time(), 6),
+        "pid": os.getpid(),
+        "rank": os.environ.get("JAX_PROCESS_ID", ""),
+        "incarnation": int(os.environ.get("CME213_INCARNATION", "0") or 0),
+        "healthy": healthy,
+        "platform": platform,
+        "device_count": device_count,
+        "probe_ms": probe_ms,
+        "stages": stages,
+    }
+
+    gauge("diag.device.healthy").set(1.0 if healthy else 0.0)
+    gauge("diag.device.count").set(float(device_count))
+    if probe_ms is not None:
+        gauge("diag.device.probe_ms").set(float(probe_ms))
+    mem = by_name.get("memory")
+    if mem is not None and mem["ok"] and isinstance(mem["detail"], dict):
+        in_use = sum(v.get("bytes_in_use", 0)
+                     for v in mem["detail"].values()
+                     if isinstance(v, dict))
+        if in_use:
+            gauge("diag.device.memory_bytes_in_use").set(float(in_use))
+
+    record_event("device-health", healthy=healthy, platform=platform,
+                 devices=device_count, probe_ms=probe_ms)
+
+    global _LAST_HEALTH
+    with _LOCK:
+        _LAST_HEALTH = report
+    if ring:
+        path = _append_ring(report)
+        if path:
+            report["ring_path"] = path
+    return report
+
+
+def last_health() -> dict | None:
+    """Most recent in-process health report (None before any probe)."""
+    with _LOCK:
+        return dict(_LAST_HEALTH) if _LAST_HEALTH else None
+
+
+def ring_path() -> str | None:
+    d = os.environ.get(DIAG_DIR_ENV, "").strip()
+    return os.path.join(d, RING_NAME) if d else None
+
+
+def _append_ring(report: dict) -> str | None:
+    """Append one report line to the JSONL history ring, keeping the last
+    :data:`RING_CAP` entries (rewrite-via-tmp + ``os.replace``, the same
+    torn-write discipline as the flight recorder).  Best-effort: a broken
+    disk must not fail a health probe."""
+    path = ring_path()
+    if not path:
+        return None
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        lines: list[str] = []
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        lines.append(json.dumps(report, default=str))
+        lines = lines[-RING_CAP:]
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+    except Exception:  # noqa: BLE001 — diagnostics never take down the host
+        return None
+
+
+def read_ring() -> list:
+    """Parsed entries of the health ring (oldest first; [] when absent)."""
+    path = ring_path()
+    if not path or not os.path.exists(path):
+        return []
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                out.append(json.loads(ln))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+# ------------------------------------------- predicted-vs-measured costs
+
+def attribution_enabled() -> bool:
+    return os.environ.get(ATTRIBUTION_ENV, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def tolerance() -> float:
+    try:
+        tol = float(os.environ.get(TOLERANCE_ENV, "2.0") or 2.0)
+    except ValueError:
+        tol = 2.0
+    return max(tol, 1.0)
+
+
+def measured_cost(fn, args: tuple) -> dict:
+    """XLA's own accounting for ``fn(*args)``: lower + compile (cache-hit
+    cheap for already-compiled programs) and read ``cost_analysis()``.
+    Returns ``{"flops": float|None, "bytes": float|None}`` — None when
+    the backend does not report that column."""
+    import jax
+
+    jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+    analysis = jfn.lower(*args).compile().cost_analysis()
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    if not isinstance(analysis, dict):
+        analysis = {}
+
+    def pick(key):
+        v = analysis.get(key)
+        return float(v) if v is not None else None
+
+    return {"flops": pick("flops"), "bytes": pick("bytes accessed")}
+
+
+def check_attribution(op: str, rung: str, shape_class: str, fn,
+                      args: tuple, cost, tol: float | None = None) -> dict:
+    """Compare the roofline model ``cost`` (a ``roofline.Cost``) against
+    ``compiled.cost_analysis()`` for ``fn(*args)``; record the row in the
+    in-process calibration table and emit ``attribution-mismatch`` when
+    any ratio falls outside ``[1/tol, tol]``."""
+    from .metrics import counter
+    from .trace import record_event
+
+    tol = tolerance() if tol is None else max(float(tol), 1.0)
+    measured = measured_cost(fn, args)
+    row = {"op": op, "rung": rung, "shape_class": shape_class, "tol": tol,
+           "predicted_flops": float(cost.flops),
+           "predicted_bytes": float(cost.nbytes),
+           "measured_flops": measured["flops"],
+           "measured_bytes": measured["bytes"],
+           "flops_ratio": None, "bytes_ratio": None,
+           "mismatches": [], "ok": True}
+    for metric, predicted, got in (
+            ("flops", float(cost.flops), measured["flops"]),
+            ("bytes", float(cost.nbytes), measured["bytes"])):
+        if got is None or got <= 0 or predicted <= 0:
+            continue  # no signal from one side -> nothing to contradict
+        ratio = round(got / predicted, 4)
+        row[f"{metric}_ratio"] = ratio
+        if ratio > tol or ratio < 1.0 / tol:
+            row["ok"] = False
+            row["mismatches"].append(metric)
+            counter("diag.attribution.mismatches").inc()
+            record_event("attribution-mismatch", op=op, rung=rung,
+                         shape_class=shape_class, metric=metric,
+                         predicted=predicted, measured=got, ratio=ratio)
+    counter("diag.attribution.checks").inc()
+    with _LOCK:
+        _ATTRIBUTION.append(row)
+    return row
+
+
+def maybe_check_attribution(op: str, rung: str, shape_class: str, fn,
+                            probe, cost):
+    """Dispatch-time hook (``programs.get``): run the cross-check only
+    when ``CME213_DIAG_ATTRIBUTION`` is on, and never let a diagnostics
+    failure take the program cache down with it."""
+    if cost is None or probe is None or not attribution_enabled():
+        return None
+    from .metrics import counter
+
+    try:
+        args = probe() if callable(probe) else tuple(probe)
+        return check_attribution(op, rung, shape_class, fn, args, cost)
+    except Exception:  # noqa: BLE001 — attribution is best-effort
+        counter("diag.attribution.errors").inc()
+        return None
+
+
+def attribution_records() -> list:
+    """The in-process calibration table (one row per check)."""
+    with _LOCK:
+        return [dict(r) for r in _ATTRIBUTION]
+
+
+def reset() -> None:
+    """Forget in-process diagnostic state (tests)."""
+    global _LAST_HEALTH, _OPEN_STAGE, _LAST_FAILED_STAGE
+    with _LOCK:
+        _LAST_HEALTH = None
+        _OPEN_STAGE = None
+        _LAST_FAILED_STAGE = None
+        _ATTRIBUTION.clear()
+
+
+# ------------------------------------------------------------- calibration
+
+def calibrate() -> list:
+    """Predicted-vs-measured table for the flagship ops on the local
+    backend: one small program each for spmv (flat scan rung), heat
+    (reference stencil), and sort, checked against the same
+    ``core/roofline.py`` models their bench rows are graded with.
+    Returns the rows (also appended to :func:`attribution_records`)."""
+    from .platform import apply_platform_env
+    apply_platform_env()
+    import jax.numpy as jnp
+
+    from . import roofline
+
+    rows = []
+
+    def run(op, rung, shape_class, fn, args, cost):
+        try:
+            rows.append(check_attribution(op, rung, shape_class, fn,
+                                          tuple(args), cost))
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            rows.append({"op": op, "rung": rung, "shape_class": shape_class,
+                         "error": f"{type(e).__name__}: {e}"[:300],
+                         "ok": False})
+
+    n, iters = 2048, 4
+    try:
+        from ..apps.spmv_scan import _build_runner
+        run("spmv_scan", "flat", f"n{n}/i{iters}",
+            _build_runner("flat", iters),
+            (jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32),
+             jnp.zeros(n, jnp.int32), jnp.zeros(1, jnp.int32)),
+            roofline.spmv_scan_cost(n, iters))
+    except Exception as e:  # noqa: BLE001
+        rows.append({"op": "spmv_scan", "rung": "flat",
+                     "shape_class": f"n{n}/i{iters}",
+                     "error": f"{type(e).__name__}: {e}"[:300], "ok": False})
+
+    side, order = 64, 2
+    try:
+        from ..ops.stencil import run_heat
+        run("heat", "xla", f"order{order}/{side}x{side}",
+            lambda u: run_heat(u, iters, order, 0.1, 0.1),
+            (jnp.zeros((side, side), jnp.float32),),
+            roofline.heat_cost(side, side, order=order, iters=iters))
+    except Exception as e:  # noqa: BLE001
+        rows.append({"op": "heat", "rung": "xla",
+                     "shape_class": f"order{order}/{side}x{side}",
+                     "error": f"{type(e).__name__}: {e}"[:300], "ok": False})
+
+    sn = 4096
+    run("sort", "xla", f"n{sn}", lambda x: jnp.sort(x),
+        (jnp.zeros(sn, jnp.float32),),
+        roofline.sort_cost(sn, kind="merge", key_bytes=4))
+    return rows

@@ -66,6 +66,23 @@ Spec grammar (comma-separated clauses)::
                                   hook is injectable so tests advance a
                                   virtual clock instead of waiting
                                   wall-time; first incarnation only
+    unreachable:<nth>[:<count>]   calls <nth> .. <nth>+<count>-1 (1-based)
+                                  of ``maybe_unreachable(...)`` report the
+                                  device as unreachable — consulted by
+                                  ``platform.device_preflight`` and the
+                                  doctor's liveness probe
+                                  (``core/diag.py``), so a dead device is
+                                  deterministically injectable without a
+                                  dead device; first incarnation only
+    stage:<op>:<stage>[:<nth>[:<count>]]
+                                  the <nth> call of
+                                  ``maybe_fail_stage(op, stage)`` raises
+                                  InjectedFault pre-tagged with the named
+                                  dispatch stage (lower | compile |
+                                  execute | conformance) — drives the
+                                  staged kernel-forensics attribution in
+                                  ``core/diag.py`` end to end; first
+                                  incarnation only
 
 Op names are dotted paths (``spmv_scan.pallas-fused``, ``heat.pipeline``,
 ``sweep.heat_bandwidth``); colons are reserved for the grammar.
@@ -104,10 +121,13 @@ class FaultSpecError(ValueError):
 @dataclass
 class _Clause:
     kind: str           # fail | nan | ckpt | rankkill | wrong | oom | slow
-    op: str             # op name ("truncate" for ckpt; rank id for rankkill)
+                        # | unreachable | stage
+    op: str             # op name ("truncate" for ckpt; rank id for rankkill;
+                        # "*" for the op-agnostic unreachable)
     nth: int = 1        # 1-based trigger call (rankkill: 0-based step)
-    count: int = 1      # consecutive triggered calls (fail/slow)
+    count: int = 1      # consecutive triggered calls (fail/slow/unreachable)
     ms: float = 0.0     # injected latency (slow only)
+    stage: str = ""     # dispatch stage (stage only)
     calls: int = 0      # mutable per-clause call counter
 
     def fires(self) -> bool:
@@ -130,12 +150,14 @@ class FaultPlan:
             parts = raw.split(":")
             kind = parts[0]
             if (kind not in ("fail", "nan", "ckpt", "rankkill", "wrong",
-                             "oom", "slow") or len(parts) < 2):
+                             "oom", "slow", "unreachable", "stage")
+                    or len(parts) < 2):
                 raise FaultSpecError(
                     f"bad fault clause {raw!r} (kinds: fail:<op>[:nth[:count]]"
                     f", nan:<op>[:nth], wrong:<op>[:nth], oom:<op>[:nth], "
                     f"slow:<op>[:ms[:nth[:count]]], ckpt:truncate[:nth], "
-                    f"rankkill:<rank>[:step])")
+                    f"rankkill:<rank>[:step], unreachable:<nth>[:count], "
+                    f"stage:<op>:<stage>[:nth[:count]])")
             try:
                 if kind == "fail":
                     clauses.append(_Clause(
@@ -149,6 +171,22 @@ class FaultPlan:
                             f"slow clause needs ms >= 0, got {ms}")
                     clauses.append(_Clause(
                         kind, parts[1], ms=ms,
+                        nth=int(parts[3]) if len(parts) > 3 else 1,
+                        count=int(parts[4]) if len(parts) > 4 else 1))
+                elif kind == "unreachable":
+                    clauses.append(_Clause(
+                        kind, "*",
+                        nth=int(parts[1]),
+                        count=int(parts[2]) if len(parts) > 2 else 1))
+                elif kind == "stage":
+                    if len(parts) < 3 or parts[2] not in (
+                            "lower", "compile", "execute", "conformance"):
+                        raise FaultSpecError(
+                            f"stage clause needs stage:<op>:<stage> with "
+                            f"stage in lower|compile|execute|conformance, "
+                            f"got {raw!r}")
+                    clauses.append(_Clause(
+                        kind, parts[1], stage=parts[2],
                         nth=int(parts[3]) if len(parts) > 3 else 1,
                         count=int(parts[4]) if len(parts) > 4 else 1))
                 elif kind in ("nan", "wrong", "oom"):
@@ -306,6 +344,46 @@ def maybe_oom(op: str) -> None:
             raise InjectedResourceExhausted(
                 f"RESOURCE_EXHAUSTED: injected out-of-memory in {op} "
                 f"(call {c.calls})")
+
+
+def maybe_unreachable(op: str = "device") -> bool:
+    """True if an ``unreachable:<nth>`` clause fires on this call — the
+    deterministic stand-in for a dead/hung device.  ``op`` names the
+    probe point for the ``fault-injected`` record (the clause itself is
+    op-agnostic: device death is not scoped to one kernel).  First
+    incarnation only, so a launcher restart finds the device back."""
+    plan = active()
+    if plan is None:
+        return False
+    fired = False
+    for c in plan.clauses:
+        if c.kind != "unreachable":
+            continue
+        if c.fires() and incarnation() == 0:
+            _record("unreachable", op, call=c.calls)
+            fired = True
+    return fired
+
+
+def maybe_fail_stage(op: str, stage: str) -> None:
+    """Raise InjectedFault pre-tagged with ``stage`` if a
+    ``stage:<op>:<stage>`` clause fires on this call.  The tag (the
+    ``_cme213_stage`` attribute ``core/diag.py`` reads) survives the
+    exception's trip up the dispatch ladder, so forensics attribution can
+    be tested for every stage without a real Mosaic/XLA failure.  First
+    incarnation only."""
+    plan = active()
+    if plan is None:
+        return
+    for c in plan.clauses:
+        if c.kind != "stage" or c.op != op or c.stage != stage:
+            continue
+        if c.fires() and incarnation() == 0:
+            _record("stage", op, stage=stage, call=c.calls)
+            e = InjectedFault(
+                f"injected {stage}-stage failure in {op} (call {c.calls})")
+            e._cme213_stage = stage  # read by diag.failure_stage
+            raise e
 
 
 def maybe_slow(op: str, sleep=None) -> float:

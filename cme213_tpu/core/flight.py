@@ -114,6 +114,15 @@ def dump(reason: str, exc: BaseException | None = None) -> str | None:
             return None
         _DUMPING = True
     try:
+        # last health snapshot + open/last-failed forensics stage
+        # (core/diag.py) — best-effort: a crash dump without them still
+        # beats no dump
+        try:
+            from . import diag
+            health = diag.last_health()
+            forensics = diag.forensics_state()
+        except Exception:  # noqa: BLE001
+            health, forensics = None, None
         events = trace.events()[-DUMP_EVENTS:]
         doc = {
             "flight": 1,
@@ -126,6 +135,8 @@ def dump(reason: str, exc: BaseException | None = None) -> str | None:
             "traceback": ("".join(traceback.format_exception(
                 type(exc), exc, exc.__traceback__)) if exc else None),
             "open_spans": _open_spans(events),
+            "health": health,
+            "forensics": forensics,
             "events": events,
             "metrics": metrics.snapshot(),
         }

@@ -95,6 +95,10 @@ def device_preflight(seconds: float = 90.0) -> bool:
     """
     import threading
 
+    from .faults import maybe_unreachable
+    if maybe_unreachable("device.preflight"):
+        return False
+
     done = threading.Event()
     ok = [False]
 

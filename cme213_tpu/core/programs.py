@@ -44,7 +44,8 @@ from __future__ import annotations
 
 import threading
 
-from . import metrics
+from . import diag, metrics
+from .faults import maybe_fail_stage
 from .trace import record_event, span
 
 _LOCK = threading.RLock()
@@ -67,7 +68,7 @@ def _key(op: str, rung: str, shape_class: str, dtype, static: dict) -> tuple:
 
 
 def get(op: str, rung: str, shape_class: str, build, *, dtype="f32",
-        warm=None, **static):
+        warm=None, cost=None, probe=None, **static):
     """The process-wide program for ``(op, rung, shape_class, dtype,
     static)`` — built, warmed, and cached on first use; a dict lookup
     ever after.
@@ -77,6 +78,15 @@ def get(op: str, rung: str, shape_class: str, build, *, dtype="f32",
     ``<op>.compile`` span on a miss, so the compile/run split and the
     retrace detector keep measuring exactly what they did before, and a
     second call on a known shape class measurably does *nothing*.
+
+    Forensics (``core/diag.py``): ``build()`` runs under the ``lower``
+    stage scope and ``warm(fn)`` under ``compile``, so an exception out of
+    a miss is attributed to the phase that actually died (a Mosaic error
+    escaping warmup is refined back to ``lower`` by message).  Attribution
+    (opt-in via ``CME213_DIAG_ATTRIBUTION``): pass the roofline ``cost``
+    and a zero-arg ``probe`` returning example args and a fresh program is
+    cross-checked against ``compiled.cost_analysis()`` right after it is
+    cached — the point where one extra lowering is cheapest.
     """
     key = _key(op, rung, shape_class, dtype, static)
     with _LOCK:
@@ -90,11 +100,16 @@ def get(op: str, rung: str, shape_class: str, build, *, dtype="f32",
                  shape_class=shape_class)
     metrics.counter("programs.misses").inc()
     with span(f"{op}.compile", kernel=rung, shape_class=shape_class):
-        fn = build()
+        maybe_fail_stage(f"{op}.{rung}", "lower")
+        with diag.stage_scope(f"{op}.{rung}", "lower"):
+            fn = build()
         if warm is not None:
-            warm(fn)
+            maybe_fail_stage(f"{op}.{rung}", "compile")
+            with diag.stage_scope(f"{op}.{rung}", "compile"):
+                warm(fn)
     with _LOCK:
         _CACHE[key] = fn
+    diag.maybe_check_attribution(op, rung, shape_class, fn, probe, cost)
     return fn
 
 

@@ -38,8 +38,9 @@ from dataclasses import dataclass, field
 from enum import Enum
 
 from . import metrics
+from .diag import failure_stage
 from .errors import FrameworkError
-from .faults import maybe_fail
+from .faults import maybe_fail, maybe_fail_stage
 from .trace import record_event
 
 
@@ -309,7 +310,10 @@ def with_fallback(op: str, ladder, policy: RetryPolicy | None = None,
     is consulted before everything: a rung with an open circuit is routed
     around without executing (kind ``breaker_open``), and execution
     successes/failures feed its state machine.  Each failed rung emits a
-    structured ``rung-failed`` event; the serving rung emits ``served``
+    structured ``rung-failed`` event plus a stage-attributed
+    ``kernel-failure`` forensics event (``core/diag.py`` decides the
+    ``lower``/``compile``/``execute``/``conformance`` bucket from the
+    exception's stage tag or message); the serving rung emits ``served``
     with ``demoted`` and the failure list, so capture logs show which
     kernel actually handled the request.  All-rungs-failed raises
     FrameworkError chained to the last failure.
@@ -340,6 +344,12 @@ def with_fallback(op: str, ladder, policy: RetryPolicy | None = None,
                 metrics.counter("fallback.demotions").inc()
                 record_event("rung-failed", op=op, rung=name,
                              kind=kind.value, error=type(e).__name__)
+                # forensics: a raising probe usually died while building/
+                # warming its probe program — the stage tag (or message
+                # heuristics) says which phase, defaulting to conformance
+                record_event("kernel-failure", op=op, kernel=name,
+                             error=type(e).__name__,
+                             stage=failure_stage(e, default="conformance"))
                 last = e
                 continue
             if not admitted:
@@ -350,9 +360,12 @@ def with_fallback(op: str, ladder, policy: RetryPolicy | None = None,
                 record_event("rung-failed", op=op, rung=name,
                              kind=FailureKind.WRONG_ANSWER.value,
                              error="ConformanceFailed")
+                record_event("kernel-failure", op=op, kernel=name,
+                             error="ConformanceFailed", stage="conformance")
                 continue
         try:
             maybe_fail(f"{op}.{name}")
+            maybe_fail_stage(f"{op}.{name}", "execute")
             value = (thunk() if policy is None
                      else policy.run(thunk, op=f"{op}.{name}"))
         except Exception as e:  # noqa: BLE001 — every rung failure is data
@@ -362,6 +375,8 @@ def with_fallback(op: str, ladder, policy: RetryPolicy | None = None,
             metrics.counter("fallback.demotions").inc()
             record_event("rung-failed", op=op, rung=name, kind=kind.value,
                          error=type(e).__name__)
+            record_event("kernel-failure", op=op, kernel=name,
+                         error=type(e).__name__, stage=failure_stage(e))
             if breaker is not None:
                 breaker.record_failure(op, name, kind)
             last = e

@@ -93,8 +93,12 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     # bench harness (bench/run_all.py, bench.py)
     "sweep-failed": ("sweep", "attempt", "error"),
     "sweep-complete": ("sweep", "rows", "ms"),
-    "kernel-failure": ("op", "kernel", "error"),
+    "kernel-failure": ("op", "kernel", "error", "stage"),
     "device-memory": ("path", "bytes"),
+    # device-health doctor + staged forensics (core/diag.py)
+    "device-health": ("healthy", "platform", "devices", "probe_ms"),
+    "attribution-mismatch": ("op", "rung", "shape_class", "metric",
+                             "predicted", "measured", "ratio"),
     # compile/run split (this module; ROADMAP item 5's measurement half)
     "compile-retrace": ("op", "shape_class", "kernel", "count"),
     # program cache (core/programs.py; ROADMAP item 5's amortization half)

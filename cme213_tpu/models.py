@@ -89,6 +89,12 @@ def _tune(argv: list[str]) -> int:
     return tune_cli.main(argv)
 
 
+def _doctor(argv: list[str]) -> int:
+    from . import doctor_cli
+
+    return doctor_cli.main(argv)
+
+
 WORKLOADS: dict[str, Workload] = {
     w.name: w
     for w in (
@@ -125,6 +131,14 @@ WORKLOADS: dict[str, Workload] = {
                  "op's registered candidate configs, persist winners to "
                  "CME213_TUNE_CACHE; show | clear the cached winners",
                  _tune),
+        # not a reference workload: the diagnostic layer standing in for
+        # the reference's checkCudaErrors/cudaGetLastError discipline —
+        # staged device-health probes + predicted-vs-measured calibration
+        Workload("doctor", "diagnostics", "staged device-health ladder "
+                 "(enumerate, memory, timed liveness; exit 1 when "
+                 "unhealthy, --json for the structured report); "
+                 "calibrate: roofline cost models vs XLA cost_analysis "
+                 "per (op, rung, shape_class)", _doctor),
     )
 }
 

@@ -394,6 +394,7 @@ def run_heat_resilient(u, iters: int, order: int, xcfl, ycfl,
                 lambda: runner_at_tile(ty_cur), dtype=str(u_host.dtype),
                 warm=lambda fn: check_op(f"heat.{rung}",
                                          fn(jnp.array(u_host))),
+                cost=cost, probe=lambda: (jnp.array(u_host),),
                 iters=iters, xcfl=xcfl, ycfl=ycfl, bc=bc, k=k,
                 tile_y=ty_cur, tile_x=tile_x, interpret=interpret)
             with span("heat.run", kernel=rung, size=gy, iters=iters,

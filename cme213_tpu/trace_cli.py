@@ -93,6 +93,18 @@ def _rank_label(rec: dict) -> str:
     return f"r{r}" if isinstance(r, int) else "main"
 
 
+def _error_class(error) -> str:
+    """Forensics bucket for a kernel-failure ``error`` field: the
+    exception type name when the field looks like ``Type: message``,
+    else the (truncated) text itself — dispatch records type names,
+    bench rows record free text."""
+    s = str(error or "?").strip()
+    head = s.split(":", 1)[0].strip()
+    if head and " " not in head and len(head) <= 40:
+        return head
+    return s[:40]
+
+
 def _percentiles(vals: list[float]) -> dict:
     vals = sorted(vals)
 
@@ -241,6 +253,57 @@ def summarize(events: list[dict], out=None) -> dict:
         for e in conf_failed:
             w(f"  failed: {e.get('op')}.{e.get('rung')} "
               f"[{e.get('shape_class')}] {e.get('detail')}\n")
+
+    # staged kernel forensics (core/diag.py): every kernel-failure from
+    # dispatch/bench/serve carries a stage tag; group by (op, kernel,
+    # stage, error class), with conformance REFUSALS (the gate said no)
+    # rendered apart from the lower/compile/execute CRASHES — "diverged
+    # from reference" and "Mosaic blew up" are different diagnoses
+    kfail = [e for e in events if e["event"] == "kernel-failure"]
+    forensics = Counter(
+        (str(e.get("op")), str(e.get("kernel")), str(e.get("stage") or "?"),
+         _error_class(e.get("error"))) for e in kfail)
+    if kfail:
+        crashes = sorted((k, n) for k, n in forensics.items()
+                         if k[2] != "conformance")
+        refusals = sorted((k, n) for k, n in forensics.items()
+                          if k[2] == "conformance")
+        w(f"kernel forensics: {len(kfail)} failure(s), "
+          f"{sum(n for _, n in crashes)} crash(es), "
+          f"{sum(n for _, n in refusals)} conformance refusal(s)\n")
+        if crashes:
+            w(f"  {'op.kernel':<30} {'stage':<12} {'error class':<24} "
+              f"{'count':>5}\n")
+            for (op, kern, stage, err), n in crashes:
+                w(f"  {f'{op}.{kern}':<30} {stage:<12} {err:<24} {n:>5}\n")
+        for (op, kern, _, err), n in refusals:
+            w(f"  refused: {op}.{kern} ({err}) x{n}\n")
+
+    # device health (core/diag.py doctor ladder)
+    health_evs = [e for e in events if e["event"] == "device-health"]
+    health = None
+    if health_evs:
+        last = health_evs[-1]
+        health = {"probes": len(health_evs),
+                  "last_healthy": bool(last.get("healthy")),
+                  "platform": last.get("platform"),
+                  "devices": last.get("devices"),
+                  "probe_ms": last.get("probe_ms")}
+        w(f"device health: {len(health_evs)} probe(s); last "
+          f"{'HEALTHY' if health['last_healthy'] else 'UNHEALTHY'} "
+          f"({health['platform']}, {health['devices']} device(s), "
+          f"probe {health['probe_ms']} ms)\n")
+
+    # predicted-vs-measured attribution mismatches (core/diag.py): the
+    # roofline cost model disagreed with compiled.cost_analysis()
+    mismatches = [e for e in events if e["event"] == "attribution-mismatch"]
+    if mismatches:
+        w(f"attribution mismatches: {len(mismatches)} "
+          f"(cost model vs XLA cost_analysis)\n")
+        for e in mismatches:
+            w(f"  {e.get('op')}.{e.get('rung')} [{e.get('shape_class')}] "
+              f"{e.get('metric')}: predicted {e.get('predicted')} "
+              f"measured {e.get('measured')} (x{e.get('ratio')})\n")
 
     # admission decisions (core/admission.py): rejections and the
     # chunk/tile shrink responses
@@ -507,6 +570,11 @@ def summarize(events: list[dict], out=None) -> dict:
                         for (ev, field), n in invalid.items()},
             "conformance": {f"{op}.{rung}": {"ok": ok, "count": n}
                             for (op, rung, ok), n in conf.items()},
+            "forensics": {f"{op}.{kern}:{stage}:{err}": n
+                          for (op, kern, stage, err), n
+                          in sorted(forensics.items())},
+            "health": health,
+            "attribution_mismatches": len(mismatches),
             "admission": {"rejected": len(rejected), "shrunk": len(shrunk)},
             "serving": serving,
             "phases": phases,
@@ -714,6 +782,23 @@ def render_flight(doc: dict, out=None) -> None:
         for s in open_spans:
             w(f"  {s.get('span')} (id {s.get('id')}, "
               f"parent {s.get('parent')})\n")
+    health = doc.get("health")
+    if health:
+        w(f"last device health: "
+          f"{'HEALTHY' if health.get('healthy') else 'UNHEALTHY'} "
+          f"({health.get('platform')}, {health.get('device_count')} "
+          f"device(s), probe {health.get('probe_ms')} ms)\n")
+        for st in health.get("stages") or []:
+            if not st.get("ok"):
+                w(f"  failed stage {st.get('stage')}: "
+                  f"{st.get('detail')}\n")
+    forensics = doc.get("forensics") or {}
+    for label, frame in (("open forensics stage", forensics.get("open")),
+                         ("last failed stage",
+                          forensics.get("last_failed"))):
+        if frame:
+            tail = (f" ({frame['error']})" if frame.get("error") else "")
+            w(f"{label}: {frame.get('op')} @ {frame.get('stage')}{tail}\n")
     events = doc.get("events") or []
     w(f"last {len(events)} event(s) before death:\n")
     render_timeline(events, out=out)

@@ -1,0 +1,382 @@
+"""Device-health doctor, staged forensics, and attribution (core/diag.py).
+
+Covers the diagnostic ladder end to end: probe pass/timeout/injected-
+unreachable, stage attribution for all four dispatch stages through real
+``with_fallback`` dispatch, cost-analysis mismatch detection against a
+deliberately wrong model, health-ring persistence across a subprocess,
+and the ``doctor`` CLI's ``--json`` round-trip and exit codes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import pytest
+
+from cme213_tpu.core import diag, faults, programs, trace
+from cme213_tpu.core.faults import injected
+from cme213_tpu.core.resilience import with_fallback
+from cme213_tpu.core.roofline import Cost
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    trace.clear_events()
+    diag.reset()
+    faults.reset()
+    yield
+    trace.clear_events()
+    diag.reset()
+    faults.reset()
+
+
+# ------------------------------------------------------------ health ladder
+
+def test_health_report_passes_on_cpu():
+    rep = diag.health_report(timeout_s=60.0)
+    assert rep["healthy"] is True
+    assert rep["platform"] == "cpu"
+    assert rep["device_count"] >= 1
+    assert rep["probe_ms"] is not None and rep["probe_ms"] >= 0
+    stages = {s["stage"]: s for s in rep["stages"]}
+    assert stages["enumerate"]["ok"] and stages["liveness"]["ok"]
+    # the report emitted a schema-valid device-health event
+    evs = trace.events("device-health")
+    assert evs and trace.validate_record(evs[-1]) == []
+    assert evs[-1]["healthy"] is True
+    # ... and set the gauges render_prometheus picks up
+    from cme213_tpu.core import metrics
+    snap = metrics.snapshot()["gauges"]
+    assert snap["diag.device.healthy"] == 1.0
+    assert snap["diag.device.count"] == rep["device_count"]
+    assert "cme213_diag_device_healthy 1" in metrics.render_prometheus()
+
+
+def test_health_probe_timeout_is_a_report_not_a_hang(monkeypatch):
+    import threading
+
+    hang = threading.Event()
+    monkeypatch.setattr(diag, "_probe_liveness",
+                        lambda: hang.wait(30))
+    rep = diag.health_report(timeout_s=0.2)
+    assert rep["healthy"] is False
+    live = next(s for s in rep["stages"] if s["stage"] == "liveness")
+    assert live["timed_out"] and not live["ok"]
+    hang.set()
+
+
+def test_health_report_injected_unreachable():
+    with injected("unreachable:1"):
+        rep = diag.health_report(timeout_s=60.0)
+    assert rep["healthy"] is False
+    live = next(s for s in rep["stages"] if s["stage"] == "liveness")
+    assert not live["ok"] and "unreachable" in live["detail"]
+    # enumerate still succeeded: the report says WHICH stage died
+    assert next(s for s in rep["stages"]
+                if s["stage"] == "enumerate")["ok"]
+    assert trace.events("device-health")[-1]["healthy"] is False
+
+
+def test_unreachable_is_incarnation_gated(monkeypatch):
+    monkeypatch.setenv("CME213_INCARNATION", "1")
+    with injected("unreachable:1"):
+        assert faults.maybe_unreachable("x") is False
+
+
+def test_device_preflight_consults_unreachable():
+    from cme213_tpu.core.platform import device_preflight
+
+    with injected("unreachable:1"):
+        assert device_preflight(30.0) is False
+    assert device_preflight(30.0) is True
+
+
+# -------------------------------------------------------- staged forensics
+
+def _dispatch_stages():
+    """One with_fallback dispatch whose rung builds through the program
+    cache and conformance-gates — the real four-stage ladder."""
+
+    def gate(rung):
+        from cme213_tpu.core import conformance
+        return conformance.check(
+            "diagop", rung, "n8",
+            candidate=lambda: jnp.arange(8.0),
+            reference=lambda: jnp.arange(8.0)).ok
+
+    def thunk():
+        fn = programs.get("diagop", "fancy", "n8",
+                          lambda: (lambda x: x + 1),
+                          warm=lambda f: f(jnp.zeros(8)))
+        return fn(jnp.arange(8.0))
+
+    return with_fallback("diagop", [("fancy", thunk),
+                                    ("safe", lambda: jnp.arange(8.0) + 1)],
+                         gate=gate)
+
+
+@pytest.mark.parametrize("clause,stage", [
+    ("stage:diagop.fancy:lower:1", "lower"),
+    ("stage:diagop.fancy:compile:1", "compile"),
+    ("stage:diagop.fancy:execute:1", "execute"),
+    ("stage:diagop.fancy:conformance:1", "conformance"),
+])
+def test_stage_attribution_through_with_fallback(clause, stage):
+    from cme213_tpu.core import conformance
+
+    conformance.reset()
+    programs.reset()
+    with injected(clause):
+        result = _dispatch_stages()
+    assert result.rung == "safe"          # demoted off the poisoned rung
+    kf = [e for e in trace.events("kernel-failure")
+          if e["kernel"] == "fancy"]
+    assert kf, "dispatch must emit a kernel-failure forensics event"
+    assert kf[0]["stage"] == stage
+    assert trace.validate_record(kf[0]) == []
+
+
+def test_conformance_refusal_tagged_conformance_stage():
+    from cme213_tpu.core import conformance
+
+    conformance.reset()
+    programs.reset()
+
+    def gate(rung):
+        return rung != "fancy"  # refuse, don't crash
+
+    r = with_fallback("diagop2", [("fancy", lambda: 1), ("safe", lambda: 2)],
+                      gate=gate)
+    assert r.value == 2
+    kf = trace.events("kernel-failure")
+    assert kf[0]["stage"] == "conformance"
+    assert kf[0]["error"] == "ConformanceFailed"
+
+
+def test_failure_stage_heuristics_without_tag():
+    assert diag.failure_stage(RuntimeError("Mosaic lowering failed")) \
+        == "lower"
+    assert diag.failure_stage(RuntimeError("XLA compilation oom: vmem")) \
+        == "compile"
+    assert diag.failure_stage(RuntimeError("boring crash")) == "execute"
+    # explicit tag wins over the default...
+    e = diag.mark_stage(RuntimeError("boring crash"), "conformance")
+    assert diag.failure_stage(e) == "conformance"
+    # ...but a compile-scope tag refines to lower on Mosaic noise
+    e2 = diag.mark_stage(RuntimeError("Mosaic unsupported op"), "compile")
+    assert diag.failure_stage(e2) == "lower"
+
+
+def test_stage_scope_records_forensics_state():
+    with pytest.raises(ValueError):
+        with diag.stage_scope("op.r", "lower"):
+            raise ValueError("nope")
+    st = diag.forensics_state()
+    assert st["open"] is None
+    assert st["last_failed"]["op"] == "op.r"
+    assert st["last_failed"]["stage"] == "lower"
+    assert st["last_failed"]["error"] == "ValueError"
+
+
+def test_flight_dump_embeds_health_and_forensics(tmp_path, monkeypatch):
+    from cme213_tpu.core import flight
+
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(tmp_path))
+    diag.health_report(timeout_s=60.0, ring=False)
+    with pytest.raises(ValueError):
+        with diag.stage_scope("heat.pipeline", "compile"):
+            raise ValueError("warm died")
+    path = flight.dump("test")
+    doc = json.loads(open(path).read())
+    assert doc["health"]["healthy"] is True
+    assert doc["forensics"]["last_failed"]["op"] == "heat.pipeline"
+    # and trace flight renders both
+    import io
+
+    from cme213_tpu import trace_cli
+    out = io.StringIO()
+    trace_cli.render_flight(trace_cli.load_flight(path), out=out)
+    text = out.getvalue()
+    assert "last device health: HEALTHY" in text
+    assert "last failed stage: heat.pipeline @ compile" in text
+
+
+# -------------------------------------------------- cost-model attribution
+
+def test_wrong_cost_model_trips_attribution_mismatch():
+    row = diag.check_attribution(
+        "fake", "r", "n4096", lambda x: x + 1.0,
+        (jnp.zeros(4096, jnp.float32),),
+        Cost(nbytes=10**12, flops=10**12))  # absurd on purpose
+    assert row["ok"] is False
+    assert "bytes" in row["mismatches"]
+    evs = trace.events("attribution-mismatch")
+    assert evs and all(trace.validate_record(e) == [] for e in evs)
+    assert any(e["metric"] == "bytes" for e in evs)
+    assert diag.attribution_records()[-1]["op"] == "fake"
+
+
+def test_sane_cost_model_passes():
+    n = 4096
+    # x + 1 reads and writes one f32 vector: ~2*4*n bytes, ~n flops
+    row = diag.check_attribution(
+        "fake", "r", f"n{n}", lambda x: x + 1.0,
+        (jnp.zeros(n, jnp.float32),),
+        Cost(nbytes=2 * 4 * n, flops=n))
+    assert row["ok"] is True
+    assert trace.events("attribution-mismatch") == []
+
+
+def test_programs_get_runs_attribution_when_enabled(monkeypatch):
+    programs.reset()
+    monkeypatch.setenv(diag.ATTRIBUTION_ENV, "1")
+    programs.get("attrop", "r", "n128", lambda: (lambda x: x * 2.0),
+                 warm=lambda f: f(jnp.zeros(128)),
+                 cost=Cost(nbytes=10**12, flops=10**12),
+                 probe=lambda: (jnp.zeros(128, jnp.float32),))
+    assert any(r["op"] == "attrop" for r in diag.attribution_records())
+    assert trace.events("attribution-mismatch")
+    # disabled by default: no re-lowering on the hot path
+    monkeypatch.delenv(diag.ATTRIBUTION_ENV)
+    diag.reset()
+    programs.reset()
+    trace.clear_events()
+    programs.get("attrop", "r", "n128", lambda: (lambda x: x * 2.0),
+                 cost=Cost(nbytes=1, flops=1),
+                 probe=lambda: (jnp.zeros(128, jnp.float32),))
+    assert diag.attribution_records() == []
+
+
+def test_calibrate_reports_flagship_ops():
+    rows = diag.calibrate()
+    assert {r["op"] for r in rows} == {"spmv_scan", "heat", "sort"}
+    spmv = next(r for r in rows if r["op"] == "spmv_scan")
+    assert "error" not in spmv
+    assert spmv["measured_bytes"] is not None
+
+
+# -------------------------------------------------------- ring persistence
+
+def test_health_ring_persists_across_subprocess(tmp_path):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "CME213_DIAG_DIR": str(tmp_path)}
+    env.pop("CME213_FAULTS", None)
+    for _ in range(2):
+        proc = subprocess.run(
+            [sys.executable, "-m", "cme213_tpu", "doctor", "--json"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["healthy"] is True
+    assert report["ring_path"] == str(tmp_path / diag.RING_NAME)
+    entries = [json.loads(ln) for ln in
+               open(tmp_path / diag.RING_NAME) if ln.strip()]
+    assert len(entries) == 2
+    assert all(e["healthy"] for e in entries)
+    assert entries[0]["pid"] != entries[1]["pid"]
+
+
+def test_ring_caps_entries(tmp_path, monkeypatch):
+    monkeypatch.setenv(diag.DIAG_DIR_ENV, str(tmp_path))
+    monkeypatch.setattr(diag, "RING_CAP", 3)
+    for i in range(5):
+        diag._append_ring({"doctor": 1, "n": i})
+    entries = diag.read_ring()
+    assert [e["n"] for e in entries] == [2, 3, 4]
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_doctor_cli_json_roundtrip_and_exit_codes(tmp_path):
+    base = {**os.environ, "JAX_PLATFORMS": "cpu",
+            "CME213_DIAG_DIR": str(tmp_path)}
+    base.pop("CME213_FAULTS", None)
+    ok = subprocess.run(
+        [sys.executable, "-m", "cme213_tpu", "doctor", "--json"],
+        capture_output=True, text=True, env=base, timeout=300)
+    assert ok.returncode == 0, ok.stderr
+    rep = json.loads(ok.stdout)
+    assert rep["healthy"] is True and rep["platform"] == "cpu"
+    assert [s["stage"] for s in rep["stages"]] == \
+        ["enumerate", "memory", "liveness"]
+
+    dead = subprocess.run(
+        [sys.executable, "-m", "cme213_tpu", "doctor", "--json"],
+        capture_output=True, text=True, timeout=300,
+        env={**base, "CME213_FAULTS": "unreachable:1"})
+    assert dead.returncode == 1
+    rep = json.loads(dead.stdout)      # still a structured report
+    assert rep["healthy"] is False
+    live = next(s for s in rep["stages"] if s["stage"] == "liveness")
+    assert "unreachable" in live["detail"]
+    # the failed probe still banked a ring entry
+    assert any(not e["healthy"] for e in
+               (json.loads(ln) for ln in
+                open(tmp_path / diag.RING_NAME) if ln.strip()))
+
+    # gated off past the first incarnation: a restarted process probes ok
+    reborn = subprocess.run(
+        [sys.executable, "-m", "cme213_tpu", "doctor"],
+        capture_output=True, text=True, timeout=300,
+        env={**base, "CME213_FAULTS": "unreachable:1",
+             "CME213_INCARNATION": "1"})
+    assert reborn.returncode == 0, reborn.stderr
+
+
+def test_trace_summary_renders_forensics_and_require(tmp_path):
+    """trace summary groups kernel-failure by stage (conformance refusals
+    apart from crashes) and --require accepts the new event names."""
+    sink = tmp_path / "t.jsonl"
+    recs = [
+        {"event": "kernel-failure", "t": 1.0, "op": "heat2d",
+         "kernel": "pipeline-k4", "stage": "lower",
+         "error": "Mosaic lowering failed", "pid": 1, "incarnation": 0},
+        {"event": "kernel-failure", "t": 2.0, "op": "spmv_scan",
+         "kernel": "pallas-fused", "stage": "conformance",
+         "error": "ConformanceFailed", "pid": 1, "incarnation": 0},
+        {"event": "device-health", "t": 3.0, "healthy": False,
+         "platform": "tpu", "devices": 4, "probe_ms": None,
+         "pid": 1, "incarnation": 0},
+        {"event": "attribution-mismatch", "t": 4.0, "op": "heat",
+         "rung": "xla", "shape_class": "n64", "metric": "bytes",
+         "predicted": 1.0, "measured": 9.0, "ratio": 9.0,
+         "pid": 1, "incarnation": 0},
+    ]
+    sink.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    import io
+
+    from cme213_tpu import trace_cli
+    events = trace_cli.load_events([str(sink)])
+    out = io.StringIO()
+    agg = trace_cli.summarize(events, out=out)
+    text = out.getvalue()
+    assert "kernel forensics: 2 failure(s), 1 crash(es), " \
+           "1 conformance refusal(s)" in text
+    assert "lower" in text and "refused: spmv_scan.pallas-fused" in text
+    assert "device health: 1 probe(s); last UNHEALTHY" in text
+    assert "attribution mismatches: 1" in text
+    assert agg["forensics"][
+        "heat2d.pipeline-k4:lower:Mosaic lowering failed"] == 1
+    assert agg["health"]["last_healthy"] is False
+    assert agg["attribution_mismatches"] == 1
+    # --require: the new names gate cleanly
+    rc = trace_cli.main(["summary", str(sink), "--require",
+                         "device-health,attribution-mismatch,"
+                         "kernel-failure"])
+    assert rc == 0
+    assert trace_cli.main(["summary", str(sink), "--require",
+                           "no-such-event"]) == 1
+
+
+def test_fault_grammar_rejects_bad_stage():
+    from cme213_tpu.core.faults import FaultPlan, FaultSpecError
+
+    with pytest.raises(FaultSpecError):
+        FaultPlan.parse("stage:op.r:warp:1")
+    with pytest.raises(FaultSpecError):
+        FaultPlan.parse("unreachable")
+    plan = FaultPlan.parse("unreachable:2:3,stage:op.r:execute:1")
+    assert plan.clauses[0].nth == 2 and plan.clauses[0].count == 3
+    assert plan.clauses[1].stage == "execute"

@@ -527,8 +527,15 @@ def test_run_all_profile_dir_hook(tmp_path, monkeypatch):
 
 
 def test_event_schema_covers_new_events():
-    for name, fields in (("kernel-failure", ("op", "kernel", "error")),
+    for name, fields in (("kernel-failure", ("op", "kernel", "error",
+                                             "stage")),
                          ("device-memory", ("path", "bytes")),
                          ("compile-retrace", ("op", "shape_class",
-                                              "kernel", "count"))):
+                                              "kernel", "count")),
+                         ("device-health", ("healthy", "platform",
+                                            "devices", "probe_ms")),
+                         ("attribution-mismatch", ("op", "rung",
+                                                   "shape_class", "metric",
+                                                   "predicted", "measured",
+                                                   "ratio"))):
         assert trace.EVENT_SCHEMA[name] == fields
