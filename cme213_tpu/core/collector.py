@@ -1,0 +1,392 @@
+"""Live gang collector — tail N per-rank trace sinks into one fleet view.
+
+The reference's hw5 prints per-rank MPI timing tables only after the run
+finishes; our existing ``trace merge`` has the same post-mortem shape — it
+parses complete files.  This module is the *live* half of fleet telemetry:
+it tails every rank's JSON-lines sink concurrently (inotify-free polling,
+so it works on any filesystem CI gives us), merges the records into one
+causally-ordered stream keyed by the process-spanning trace id
+(``core/trace.py``), and maintains the rolling aggregates the consoles
+read — per-rank heartbeat freshness, epoch-commit lag, shed/breaker/
+SLO-burn counters, span histograms.
+
+Consumers:
+
+- ``python -m cme213_tpu collect`` (this module's CLI): one-shot merged
+  state (``--once``/``--json``) or a followed merged JSONL stream
+  (``--follow``) — the gang-wide ``tail -f``.
+- ``python -m cme213_tpu top`` (``top_cli.py``): the live console.
+- ``trace merge --follow`` (``trace_cli.py``): same tailer, timeline or
+  JSONL output.
+- ``dist/launch.py``: :func:`write_fleet_exposition` folds every rank's
+  final ``metrics-snapshot`` into the federated Prometheus file
+  (``CME213_METRICS_FILE``) when the gang ends.
+
+Tailing is rotation- and truncation-safe (an inode change or a shrinking
+file resets the cursor) and partial-line tolerant (a torn tail line is
+buffered until its newline arrives) — a rank hard-killed mid-write or a
+logrotate race must never corrupt the merged view, only delay it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+import time
+
+#: events that condemn/describe gang lifecycle vs. per-request flow
+_SHED_EVENTS = {"queue-shed", "deadline-shed", "admission-rejected"}
+
+
+class SinkTailer:
+    """Incremental reader for one JSON-lines sink file.
+
+    ``poll()`` returns the complete records appended since the last call.
+    The file may not exist yet (a rank that hasn't opened its sink), may
+    be rotated (inode change) or truncated (size below the cursor) — both
+    reset the cursor to 0 so the replacement file is read from its start.
+    A partial trailing line is buffered, not parsed; malformed complete
+    lines are counted (``malformed``) and skipped.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self.malformed = 0
+        self._offset = 0
+        self._sig: tuple | None = None
+        self._buf = b""
+
+    def poll(self) -> list[dict]:
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return []
+        sig = (st.st_ino, st.st_dev)
+        if sig != self._sig or st.st_size < self._offset:
+            # rotated (new inode) or truncated: restart from the top
+            self._offset, self._buf, self._sig = 0, b"", sig
+        if st.st_size <= self._offset:
+            return []
+        try:
+            # binary mode: offsets are byte-exact (text-mode tell() lies)
+            with open(self.path, "rb") as f:
+                f.seek(self._offset)
+                chunk = f.read()
+                self._offset = f.tell()
+        except OSError:
+            return []
+        lines = (self._buf + chunk).split(b"\n")
+        self._buf = lines.pop()  # b"" when the chunk ended on a newline
+        records = []
+        for raw in lines:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                doc = json.loads(raw.decode("utf-8", errors="replace"))
+            except ValueError:
+                self.malformed += 1
+                continue
+            if not isinstance(doc, dict) or "event" not in doc:
+                self.malformed += 1
+                continue
+            doc["_file"] = self.path
+            records.append(doc)
+        return records
+
+
+def _rank_key(rec: dict) -> str:
+    rank = rec.get("rank")
+    return f"r{rank}" if rank is not None else "main"
+
+
+def _rank_sort_key(label: str):
+    if label.startswith("r") and label[1:].isdigit():
+        return (0, int(label[1:]), label)
+    return (1, 0, label)
+
+
+def _new_row() -> dict:
+    return {"pid": None, "incarnation": 0, "state": "unknown", "step": None,
+            "heartbeat_t": None, "last_span": None, "last_event": None,
+            "last_t": None, "breakers_open": 0, "degraded": False,
+            "events": 0, "metrics": None}
+
+
+class Collector:
+    """Merge N tailed sinks into rolling fleet aggregates.
+
+    ``patterns`` may mix literal paths and globs; globs are re-expanded
+    on every ``poll()`` so ranks that open their sink late (or replicas
+    that join) are picked up without a restart.  Each ``poll()`` returns
+    the new batch, time-ordered across files — the causally-ordered
+    merged stream — and folds it into ``state()``.
+    """
+
+    def __init__(self, patterns):
+        self.patterns = [str(p) for p in patterns]
+        self._tailers: dict[str, SinkTailer] = {}
+        self.trace_ids: set = set()
+        self.ranks: dict[str, dict] = {}
+        self.fleet: collections.Counter = collections.Counter()
+        self.spans: dict[str, dict] = {}
+        self.verdicts: list[dict] = []
+        self.recent: collections.deque = collections.deque(maxlen=64)
+        self.last_commit: dict | None = None
+        self.last_rc = None
+        self.events = 0
+        self.last_t: float | None = None
+
+    # ------------------------------------------------------------ tailing
+
+    def _expand(self) -> None:
+        for pat in self.patterns:
+            paths = (sorted(glob.glob(pat))
+                     if any(ch in pat for ch in "*?[") else [pat])
+            for p in paths:
+                if p not in self._tailers:
+                    self._tailers[p] = SinkTailer(p)
+
+    def poll(self) -> list[dict]:
+        self._expand()
+        batch: list[dict] = []
+        for tailer in self._tailers.values():
+            batch.extend(tailer.poll())
+        # time-order across files: each sink is append-ordered already,
+        # so a stable sort on t interleaves ranks causally (same-clock
+        # single host; cross-host skew is a known Dapper-style caveat)
+        batch.sort(key=lambda r: (r.get("t") or 0.0))
+        for rec in batch:
+            self._ingest(rec)
+        return batch
+
+    # ---------------------------------------------------------- ingestion
+
+    def _ingest(self, rec: dict) -> None:
+        self.events += 1
+        event = rec.get("event")
+        t = rec.get("t")
+        if isinstance(t, (int, float)):
+            self.last_t = t if self.last_t is None else max(self.last_t, t)
+        trace = rec.get("trace")
+        if trace:
+            self.trace_ids.add(str(trace))
+
+        key = _rank_key(rec)
+        row = self.ranks.setdefault(key, _new_row())
+        row["events"] += 1
+        row["last_event"] = event
+        row["last_t"] = t
+        if event != "rank-failed":
+            # rank-failed is the LAUNCHER reporting on a worker's rank:
+            # its pid is the launcher's — never the condemned worker's
+            row["pid"] = rec.get("pid", row["pid"])
+            inc = rec.get("incarnation", row["incarnation"]) or 0
+            if inc != row["incarnation"]:
+                # a restarted incarnation starts clean: stale failure
+                # state must not shadow the replacement process
+                row.update(incarnation=inc, state="unknown",
+                           breakers_open=0, degraded=False)
+
+        if event == "heartbeat":
+            row["state"] = "running"
+            row["step"] = rec.get("step")
+            row["heartbeat_t"] = t
+        elif event == "rank-failed":
+            row["state"] = "failed"
+            self.verdicts.append({"rank": rec.get("rank"),
+                                  "reason": rec.get("reason"),
+                                  "incarnation": rec.get("incarnation"),
+                                  "t": t})
+            self.fleet["verdicts"] += 1
+        elif event == "gang-launch":
+            self.fleet["launches"] += 1
+        elif event == "gang-restart":
+            self.fleet["restarts"] += 1
+        elif event == "gang-exit":
+            self.fleet["exits"] += 1
+            self.last_rc = rec.get("rc")
+        elif event == "epoch-commit":
+            self.fleet["commits"] += 1
+            self.last_commit = {"epoch": rec.get("epoch"),
+                                "step": rec.get("step"), "t": t}
+        elif event in _SHED_EVENTS:
+            self.fleet["sheds"] += 1
+        elif event == "slo-burn":
+            self.fleet["slo_burns"] += 1
+        elif event == "breaker-open":
+            self.fleet["breaker_opens"] += 1
+            row["breakers_open"] += 1
+        elif event == "breaker-close":
+            row["breakers_open"] = max(0, row["breakers_open"] - 1)
+        elif event == "request-served":
+            self.fleet["requests"] += 1
+        elif event == "served" and rec.get("demoted"):
+            row["degraded"] = True
+        elif event == "flight-dump":
+            row["state"] = "crashed"
+        elif event == "span-begin":
+            row["last_span"] = rec.get("span")
+        elif event == "span-end":
+            name = rec.get("span")
+            ms = rec.get("ms")
+            if name and isinstance(ms, (int, float)):
+                agg = self.spans.setdefault(
+                    name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+                agg["count"] += 1
+                agg["total_ms"] = round(agg["total_ms"] + ms, 3)
+                agg["max_ms"] = max(agg["max_ms"], round(ms, 3))
+        elif event == "metrics-snapshot":
+            if isinstance(rec.get("metrics"), dict):
+                row["metrics"] = rec["metrics"]
+
+        if event not in ("span-begin", "span-end", "heartbeat"):
+            self.recent.append({"t": t, "rank": key, "event": event})
+
+    # ------------------------------------------------------------- output
+
+    def state(self) -> dict:
+        """The merged fleet view, deterministic for ``--once --json``:
+        ages are computed against the newest *observed* event time, not
+        the wall clock, so re-rendering an idle capture is stable."""
+        now_t = self.last_t
+        ranks_out = {}
+        for key in sorted(self.ranks, key=_rank_sort_key):
+            row = dict(self.ranks[key])
+            hb = row.get("heartbeat_t")
+            row["heartbeat_age_s"] = (
+                round(now_t - hb, 3)
+                if hb is not None and now_t is not None else None)
+            ranks_out[key] = row
+        commit_lag_s = (
+            round(now_t - self.last_commit["t"], 3)
+            if self.last_commit and self.last_commit.get("t") is not None
+            and now_t is not None else None)
+        return {
+            "files": sorted(self._tailers),
+            "events": self.events,
+            "malformed": sum(t.malformed for t in self._tailers.values()),
+            "trace_ids": sorted(self.trace_ids),
+            "ranks": ranks_out,
+            "fleet": dict(sorted(self.fleet.items())),
+            "verdicts": list(self.verdicts),
+            "spans": {k: dict(v) for k, v in sorted(self.spans.items())},
+            "recent": list(self.recent),
+            "last_rc": self.last_rc,
+            "last_commit": self.last_commit,
+            "commit_lag_s": commit_lag_s,
+        }
+
+    def fleet_snapshots(self) -> dict[str, dict]:
+        """Last seen per-rank metrics snapshot, keyed by rank label —
+        the input shape ``metrics.render_prometheus(fleet=...)`` takes."""
+        return {key: row["metrics"] for key, row in self.ranks.items()
+                if isinstance(row.get("metrics"), dict)}
+
+
+def write_fleet_exposition(sink_paths, path: str | None = None,
+                           extra: dict[str, dict] | None = None) -> str | None:
+    """Fold the final ``metrics-snapshot`` of every sink in ``sink_paths``
+    (plus ``extra`` — e.g. the launcher's own live registry) into one
+    federated Prometheus exposition at ``path`` (default
+    ``CME213_METRICS_FILE``).  Atomic tmp + ``os.replace``; the written
+    path is pinned against the atexit single-process overwrite.  Returns
+    the path written, or None when unconfigured or nothing to expose."""
+    from . import metrics
+
+    path = path or os.environ.get(metrics.METRICS_FILE_ENV)
+    if not path:
+        return None
+    coll = Collector(sink_paths)
+    coll.poll()
+    fleet = coll.fleet_snapshots()
+    if extra:
+        fleet.update(extra)
+    text = metrics.render_prometheus(fleet=fleet) if fleet else ""
+    if not text:
+        return None
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    metrics.suppress_exit_exposition(path)
+    return path
+
+
+def render_state(state: dict, out) -> None:
+    """Compact text rendering of :meth:`Collector.state` (the ``collect``
+    one-shot default; ``top`` owns the full console)."""
+    ids = state["trace_ids"]
+    out.write(f"fleet: {len(state['ranks'])} proc(s), "
+              f"{state['events']} event(s), "
+              f"{len(ids)} trace id(s)"
+              + (f" [{ids[0]}]" if len(ids) == 1 else "") + "\n")
+    for key, row in state["ranks"].items():
+        hb = row["heartbeat_age_s"]
+        out.write(f"  {key:<6} {row['state']:<8} pid={row['pid']} "
+                  f"inc={row['incarnation']} step={row['step']} "
+                  f"hb_age={hb if hb is not None else '-'}s "
+                  f"last={row['last_event']}\n")
+    if state["fleet"]:
+        out.write("  fleet counters: "
+                  + " ".join(f"{k}={v}"
+                             for k, v in state["fleet"].items()) + "\n")
+    if state["verdicts"]:
+        for v in state["verdicts"]:
+            out.write(f"  verdict: rank {v['rank']} {v['reason']} "
+                      f"(incarnation {v['incarnation']})\n")
+    if state["malformed"]:
+        out.write(f"  malformed lines skipped: {state['malformed']}\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cme213_tpu collect",
+        description="tail per-rank trace sinks into one live fleet view")
+    ap.add_argument("files", nargs="+",
+                    help="sink files or globs (re-expanded every poll)")
+    ap.add_argument("--once", action="store_true",
+                    help="read what exists now, print the merged state, "
+                         "exit (the default unless --follow)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged state as deterministic JSON")
+    ap.add_argument("--follow", action="store_true",
+                    help="stream the merged record stream as JSONL until "
+                         "interrupted (or --max-seconds)")
+    ap.add_argument("--interval", type=float, default=0.5,
+                    help="seconds between polls in --follow mode")
+    ap.add_argument("--max-seconds", type=float, default=None,
+                    help="stop following after this many seconds")
+    args = ap.parse_args(argv)
+
+    coll = Collector(args.files)
+    if args.follow and not args.once:
+        deadline = (time.monotonic() + args.max_seconds
+                    if args.max_seconds else None)
+        try:
+            while True:
+                for rec in coll.poll():
+                    out = {k: v for k, v in rec.items() if k != "_file"}
+                    print(json.dumps(out, sort_keys=True, default=str),
+                          flush=True)
+                if deadline is not None and time.monotonic() > deadline:
+                    break
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            pass
+        return 0
+    coll.poll()
+    state = coll.state()
+    if args.json:
+        print(json.dumps(state, sort_keys=True, default=str))
+    else:
+        render_state(state, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

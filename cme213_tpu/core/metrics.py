@@ -254,7 +254,67 @@ def _counter_series(name: str) -> tuple[str, str]:
     return f"cme213_{_sanitize_name(name)}_total", ""
 
 
-def render_prometheus(snap: dict | None = None) -> str:
+def merge_snapshots(snaps: dict[str, dict]) -> dict:
+    """Fold per-rank snapshots (``{rank-label: snapshot}``) into one
+    fleet rollup — the Prometheus-federation aggregate the launcher
+    writes for a whole gang.
+
+    Counters sum.  Numeric gauges take the fleet **max** (the
+    conservative "worst rank" reading for burn/depth/degraded-style
+    gauges; non-numeric gauges are dropped, matching the renderer).
+    Histograms sum exact ``count``/``sum``, fold ``min``/``max`` with
+    min/max, and take the per-rank max of each window percentile — an
+    upper bound, since the retained windows cannot be re-interleaved.
+    """
+    counters: dict = {}
+    gauges: dict = {}
+    hists: dict = {}
+    for _, snap in sorted(snaps.items(), key=lambda kv: str(kv[0])):
+        for k, v in (snap.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + v
+        for k, v in (snap.get("gauges") or {}).items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            gauges[k] = v if k not in gauges else max(gauges[k], v)
+        for k, h in (snap.get("histograms") or {}).items():
+            m = hists.get(k)
+            if m is None:
+                hists[k] = dict(h)
+                continue
+            m["count"] = (m.get("count") or 0) + (h.get("count") or 0)
+            m["sum"] = round((m.get("sum") or 0) + (h.get("sum") or 0), 6)
+            for key, fold in (("min", min), ("max", max),
+                              ("p50", max), ("p90", max), ("p99", max)):
+                a, b = m.get(key), h.get(key)
+                m[key] = b if a is None else (a if b is None else fold(a, b))
+    for h in hists.values():
+        h["mean"] = (round((h.get("sum") or 0) / h["count"], 6)
+                     if h.get("count") else None)
+    return {"counters": dict(sorted(counters.items())),
+            "gauges": dict(sorted(gauges.items())),
+            "histograms": dict(sorted(hists.items())),
+            "ranks": sorted(snaps, key=str)}
+
+
+def _merge_labels(labels: str, extra: str | None) -> str:
+    """Combine a rendered ``{...}`` label block (or ``""``) with one
+    extra ``key="value"`` pair."""
+    if not extra:
+        return labels
+    if not labels:
+        return "{" + extra + "}"
+    return labels[:-1] + "," + extra + "}"
+
+
+def _help_text(family: str) -> str:
+    prefix = "cme213_"
+    stem = family[len(prefix):] if family.startswith(prefix) else family
+    return f"cme213_tpu registry metric {stem.replace('_', ' ')}"
+
+
+def render_prometheus(snap: dict | None = None, *,
+                      fleet: dict[str, dict] | None = None,
+                      help_lines: bool = True) -> str:
     """Render a snapshot (default: the live registry) in the Prometheus
     text exposition format.
 
@@ -264,35 +324,68 @@ def render_prometheus(snap: dict | None = None) -> str:
     segments into labels.  Numeric gauges render as gauges (non-numeric
     gauge values are skipped — Prometheus has no string samples).
     Histograms render as summaries: ``{quantile="0.5|0.9|0.99"}`` lines
-    from the retained window plus exact ``_sum``/``_count``.
+    from the retained window plus exact ``_sum``/``_count``.  Every
+    family leads with a ``# HELP`` line (suppress with
+    ``help_lines=False``).
+
+    With ``fleet`` — a ``{rank-label: snapshot}`` mapping — the
+    federated form renders instead: the :func:`merge_snapshots` rollup
+    as the unlabeled series, then every per-rank sample again with a
+    ``rank="<label>"`` label, one family block each — the scrape
+    surface a replica router/autoscaler consumes.
     """
-    snap = snapshot() if snap is None else snap
+    fams: dict[str, dict] = {}
+
+    def add(family: str, typ: str, line: str) -> None:
+        fam = fams.get(family)
+        if fam is None:
+            fam = fams[family] = {"type": typ, "samples": []}
+        fam["samples"].append(line)
+
+    def emit(s: dict, rank_label: str | None = None) -> None:
+        extra = (f'rank="{_escape_label(rank_label)}"'
+                 if rank_label is not None else None)
+        for name, value in (s.get("counters") or {}).items():
+            family, labels = _counter_series(name)
+            add(family, "counter",
+                f"{family}{_merge_labels(labels, extra)} {value}")
+        for name, value in (s.get("gauges") or {}).items():
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            pname = f"cme213_{_sanitize_name(name)}"
+            add(pname, "gauge",
+                f"{pname}{_merge_labels('', extra)} {value}")
+        for name, h in (s.get("histograms") or {}).items():
+            pname = f"cme213_{_sanitize_name(name)}"
+            for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+                if h.get(key) is not None:
+                    qlabels = _merge_labels(f'{{quantile="{q}"}}', extra)
+                    add(pname, "summary", f"{pname}{qlabels} {h[key]}")
+            add(pname, "summary",
+                f"{pname}_sum{_merge_labels('', extra)} {h.get('sum', 0)}")
+            add(pname, "summary",
+                f"{pname}_count{_merge_labels('', extra)} "
+                f"{h.get('count', 0)}")
+
+    if fleet is not None:
+        emit(merge_snapshots(fleet))
+        for label, s in sorted(fleet.items(), key=lambda kv: str(kv[0])):
+            emit(s, rank_label=str(label))
+    else:
+        emit(snapshot() if snap is None else snap)
+
     lines: list[str] = []
-
-    families: dict[str, list[str]] = {}
-    for name, value in snap.get("counters", {}).items():
-        family, labels = _counter_series(name)
-        families.setdefault(family, []).append(f"{family}{labels} {value}")
-    for family in sorted(families):
-        lines.append(f"# TYPE {family} counter")
-        lines.extend(sorted(families[family]))
-
-    for name, value in snap.get("gauges", {}).items():
-        if isinstance(value, bool) or not isinstance(value, (int, float)):
-            continue
-        pname = f"cme213_{_sanitize_name(name)}"
-        lines.append(f"# TYPE {pname} gauge")
-        lines.append(f"{pname} {value}")
-
-    for name, h in snap.get("histograms", {}).items():
-        pname = f"cme213_{_sanitize_name(name)}"
-        lines.append(f"# TYPE {pname} summary")
-        for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
-            if h.get(key) is not None:
-                lines.append(f'{pname}{{quantile="{q}"}} {h[key]}')
-        lines.append(f"{pname}_sum {h.get('sum', 0)}")
-        lines.append(f"{pname}_count {h.get('count', 0)}")
-
+    kind_order = {"counter": 0, "gauge": 1, "summary": 2}
+    for family in sorted(fams, key=lambda f: (kind_order[fams[f]["type"]],
+                                              f)):
+        fam = fams[family]
+        if help_lines:
+            lines.append(f"# HELP {family} {_help_text(family)}")
+        lines.append(f"# TYPE {family} {fam['type']}")
+        if fam["type"] == "counter":
+            lines.extend(sorted(fam["samples"]))
+        else:
+            lines.extend(fam["samples"])
     return "\n".join(lines) + "\n" if lines else ""
 
 
@@ -320,6 +413,18 @@ def reset() -> None:
         _HISTOGRAMS.clear()
 
 
+#: exposition paths the atexit writer must leave alone — a launcher that
+#: already wrote the gang's *federated* file registers it here so its own
+#: single-process snapshot doesn't clobber the fleet view at shutdown
+_EXIT_EXPOSITION_SKIP: set = set()
+
+
+def suppress_exit_exposition(path: str) -> None:
+    """Exclude ``path`` from the atexit exposition write (the
+    ``metrics-snapshot`` trace record is still emitted)."""
+    _EXIT_EXPOSITION_SKIP.add(os.path.abspath(path))
+
+
 def _emit_exit_snapshot() -> None:
     """At interpreter exit, append one ``metrics-snapshot`` event so sink
     files end with the process's final registry state.  Skipped when the
@@ -330,6 +435,9 @@ def _emit_exit_snapshot() -> None:
 
     record_event("metrics-snapshot", metrics=snapshot())
     flush_sink()
+    dest = os.environ.get(METRICS_FILE_ENV)
+    if dest and os.path.abspath(dest) in _EXIT_EXPOSITION_SKIP:
+        return
     try:
         write_exposition()
     except OSError:

@@ -27,6 +27,17 @@ in three pieces:
   automatically).  Span durations also feed the metrics registry
   (``core/metrics.py``) as ``span.<name>.ms`` histograms.
 
+- **Cross-process context**: every record is stamped with a ``trace``
+  id that spans the whole job, not just one process.  A launcher
+  (``dist/launch.py``) exports ``CME213_TRACE_CONTEXT`` — JSON
+  ``{"trace_id", "parent_span_id"}`` — into its children via
+  :func:`propagation_env`; a child inherits the id (else mints one per
+  process) and parents its root spans under the launcher's open span
+  (the ``gang-launch`` span), so a merged multi-rank trace is one
+  causal tree under one id, Dapper-style.  The serving front end
+  carries the same id on every ``SolveRequest``/``request-served``
+  record, so one id follows loadgen → queue → batch → execution.
+
 - **Sinks**: set ``CME213_TRACE_FILE`` to append each record as a JSON
   line.  The handle is opened once and cached (not reopened per event),
   guarded by a lock, flushed per line (a hard-killed rank —
@@ -64,9 +75,12 @@ from contextlib import contextmanager
 TRACE_FILE_ENV = "CME213_TRACE_FILE"
 #: ring-buffer cap on the in-process event list (0/unset = unbounded)
 TRACE_BUFFER_ENV = "CME213_TRACE_BUFFER"
+#: cross-process trace context a launcher exports to its children:
+#: JSON ``{"trace_id": str, "parent_span_id": str|null}``
+TRACE_CONTEXT_ENV = "CME213_TRACE_CONTEXT"
 
 #: Known event names -> required fields (beyond the automatic
-#: event/t/pid/rank/incarnation tags).  ``tests/test_telemetry.py``
+#: event/t/pid/rank/incarnation/trace tags).  ``tests/test_telemetry.py``
 #: statically checks every ``record_event`` call site in the package
 #: against this table; ``trace_cli.py`` validates records offline.
 EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
@@ -162,19 +176,83 @@ _SINK_FILE = None
 _ATEXIT_INSTALLED = False
 
 
+# -------------------------------------------------- cross-process context
+
+_CONTEXT_RAW: str | None = None   # env string the cached parse came from
+_CONTEXT: dict = {}
+_LOCAL_TRACE_ID: str | None = None
+
+
+def _context() -> dict:
+    """The inherited cross-process context (``{}`` outside a launched
+    child).  Re-parsed only when the env string changes — the same
+    string-compare discipline as the sink handle, so monkeypatched tests
+    see context flips without a process restart."""
+    global _CONTEXT_RAW, _CONTEXT
+    raw = os.environ.get(TRACE_CONTEXT_ENV) or None
+    if raw != _CONTEXT_RAW:
+        ctx: dict = {}
+        if raw:
+            try:
+                doc = json.loads(raw)
+                if isinstance(doc, dict):
+                    ctx = doc
+            except ValueError:
+                pass  # a torn context must never kill the workload
+        _CONTEXT_RAW, _CONTEXT = raw, ctx
+    return _CONTEXT
+
+
+def trace_id() -> str:
+    """The process-spanning trace id stamped on every record: inherited
+    from the launcher (``CME213_TRACE_CONTEXT``) when present, else
+    minted once per process — so a gang (or a loadgen session under the
+    launcher) shares one id across every pid it touches."""
+    global _LOCAL_TRACE_ID
+    inherited = _context().get("trace_id")
+    if inherited:
+        return str(inherited)
+    if _LOCAL_TRACE_ID is None:
+        _LOCAL_TRACE_ID = (f"{os.getpid():x}-"
+                           f"{time.time_ns() & 0xFFFFFFFFFF:010x}")
+    return _LOCAL_TRACE_ID
+
+
+def inherited_parent_id() -> str | None:
+    """Span id (in the spawning process) this process's root spans parent
+    under — the launcher's open ``gang-launch`` span, typically."""
+    p = _context().get("parent_span_id")
+    return str(p) if p else None
+
+
+def propagation_env() -> dict:
+    """Env entries a launcher injects into a child process so the child
+    joins this trace: the shared ``trace_id`` plus the currently open
+    span id as the child's root-span parent."""
+    ctx = {"trace_id": trace_id(),
+           "parent_span_id": current_span_id() or inherited_parent_id()}
+    return {TRACE_CONTEXT_ENV: json.dumps(ctx)}
+
+
 def _proc_tags() -> dict:
-    """The per-record process tags (pid/rank/incarnation) that let
-    ``trace merge`` reconstruct a gang view from per-rank files."""
+    """The per-record process tags (pid/rank/incarnation/trace) that let
+    ``trace merge`` and the live collector (``core/collector.py``)
+    reconstruct a gang view from per-rank files."""
     rank = os.environ.get("JAX_PROCESS_ID")
     return {
         "pid": os.getpid(),
-        "rank": int(rank) if rank is not None else None,
+        "rank": int(rank) if rank else None,
         "incarnation": int(os.environ.get("CME213_INCARNATION", "0") or 0),
+        "trace": trace_id(),
     }
 
 
 def format_trace_path(template: str, rank) -> str:
-    """Expand the ``{rank}`` placeholder of a sink-path template."""
+    """Expand the ``{rank}`` placeholder of a sink-path template.  A
+    non-rank process (``rank`` None or the empty string) expands to
+    ``main`` — a leftover literal ``{rank}`` must never reach ``open``."""
+    if rank is None or rank == "":
+        rank = "main"
     return template.replace("{rank}", str(rank))
 
 
@@ -184,9 +262,9 @@ def _resolve_sink_path() -> str | None:
         return None
     if "{rank}" in path:
         # launcher children get a concrete path from dist/launch.py; this
-        # fallback covers processes using the template env directly
-        path = format_trace_path(
-            path, os.environ.get("JAX_PROCESS_ID", "main"))
+        # fallback covers processes using the template env directly (the
+        # single-process library path), including an empty JAX_PROCESS_ID
+        path = format_trace_path(path, os.environ.get("JAX_PROCESS_ID"))
     return path
 
 
@@ -252,9 +330,9 @@ def record_event(event: str, **fields) -> dict:
     """Append a structured event to the in-process log (and the
     ``CME213_TRACE_FILE`` JSON-lines sink, when set).  Returns the record.
 
-    Every record carries ``pid``/``rank``/``incarnation`` process tags
-    (explicit fields win, e.g. the launcher reporting on a worker's
-    rank).  Sink writes reuse one cached handle and flush per line, so a
+    Every record carries ``pid``/``rank``/``incarnation``/``trace``
+    process tags (explicit fields win, e.g. the launcher reporting on a
+    worker's rank).  Sink writes reuse one cached handle and flush per line, so a
     rank hard-killed mid-solve (``os._exit``) loses nothing it recorded.
     """
     rec = {"event": event, "t": round(time.time(), 6),
@@ -349,7 +427,10 @@ def span(name: str, **tags):
     """
     sid = f"{os.getpid():x}.{next(_SPAN_COUNTER)}"
     stack = _SPAN_STACK.get()
-    parent = stack[-1] if stack else None
+    # a root span in a launched child parents under the spawning
+    # process's open span (CME213_TRACE_CONTEXT), so a merged multi-rank
+    # trace is one causal tree
+    parent = stack[-1] if stack else inherited_parent_id()
     record_event("span-begin", span=name, id=sid, parent=parent, **tags)
     token = _SPAN_STACK.set(stack + (sid,))
     handle = SpanHandle()

@@ -81,14 +81,50 @@ def _pump(rank: int, stream, out) -> None:
         out.flush()
 
 
-def _template_trace_file(env: dict, rank: int) -> None:
+def _template_trace_file(env: dict, rank: int) -> str | None:
     """Expand a ``{rank}`` placeholder in the worker's ``CME213_TRACE_FILE``
     so gang members write per-rank sink files instead of interleaving into
     one (the launcher's own events keep the un-expanded path, which
-    ``core/trace`` resolves to ``...main...`` for non-rank processes)."""
+    ``core/trace`` resolves to ``...main...`` for non-rank processes).
+    Returns the worker's resolved sink path (for the live collector and
+    the end-of-gang federated exposition), or None when unconfigured."""
     tf = env.get("CME213_TRACE_FILE")
     if tf and "{rank}" in tf:
-        env["CME213_TRACE_FILE"] = tf.replace("{rank}", str(rank))
+        tf = tf.replace("{rank}", str(rank))
+        env["CME213_TRACE_FILE"] = tf
+    return tf
+
+
+def _template_metrics_file(env: dict, rank: int) -> None:
+    """Point the worker's ``CME213_METRICS_FILE`` at a per-rank path —
+    ``{rank}``-expanded, else ``.rank<N>``-suffixed — so N workers plus
+    the launcher's federated aggregate never clobber one file."""
+    mf = env.get("CME213_METRICS_FILE")
+    if not mf:
+        return
+    if "{rank}" in mf:
+        env["CME213_METRICS_FILE"] = mf.replace("{rank}", str(rank))
+    else:
+        env["CME213_METRICS_FILE"] = f"{mf}.rank{rank}"
+
+
+def _fleet_exposition(sink_paths: list[str]) -> None:
+    """After the gang ends, fold every rank's final ``metrics-snapshot``
+    (from the per-rank sinks) plus the launcher's own live registry into
+    one federated exposition at ``CME213_METRICS_FILE`` — and pin that
+    file against the launcher's atexit single-process overwrite."""
+    dest = os.environ.get("CME213_METRICS_FILE")
+    if not dest:
+        return
+    try:
+        from ..core import metrics
+        from ..core.collector import write_fleet_exposition
+
+        write_fleet_exposition(
+            [p for p in sink_paths if p], path=dest,
+            extra={"launcher": metrics.snapshot()})
+    except Exception as exc:  # telemetry must never fail the job
+        print(f"[launcher] fleet exposition failed: {exc}", flush=True)
 
 
 def launch(np_procs: int, cmd: list[str], devices_per_proc: int | None = None,
@@ -99,10 +135,14 @@ def launch(np_procs: int, cmd: list[str], devices_per_proc: int | None = None,
     first unrecovered nonzero exit code (terminating the other ranks),
     124 on ``timeout`` expiry, else 0.  A failed rank is relaunched with
     the same rank id up to ``max_restarts`` times first."""
+    from ..core.trace import propagation_env, record_event, span
+
     coordinator = coordinator or f"127.0.0.1:{free_port()}"
     procs: dict[int, subprocess.Popen] = {}
     restarts = {rank: 0 for rank in range(np_procs)}
+    sink_paths: dict[int, str | None] = {}
     pumps = []
+    ctx_env: dict = {}
     rc = 0
 
     def spawn(rank: int, incarnation: int) -> subprocess.Popen:
@@ -110,8 +150,10 @@ def launch(np_procs: int, cmd: list[str], devices_per_proc: int | None = None,
                    JAX_COORDINATOR_ADDRESS=coordinator,
                    JAX_NUM_PROCESSES=str(np_procs),
                    JAX_PROCESS_ID=str(rank),
-                   CME213_INCARNATION=str(incarnation))
-        _template_trace_file(env, rank)
+                   CME213_INCARNATION=str(incarnation),
+                   **ctx_env)
+        sink_paths[rank] = _template_trace_file(env, rank)
+        _template_metrics_file(env, rank)
         if handshake_timeout is not None:
             env["CME213_HANDSHAKE_TIMEOUT"] = str(handshake_timeout)
         if devices_per_proc:
@@ -130,47 +172,62 @@ def launch(np_procs: int, cmd: list[str], devices_per_proc: int | None = None,
 
     deadline = (time.monotonic() + timeout) if timeout else None
     try:
-        for rank in range(np_procs):
-            procs[rank] = spawn(rank, 0)
+        # the gang-launch span is the root every child's spans parent
+        # under (via CME213_TRACE_CONTEXT), so a merged multi-rank trace
+        # is one causal tree sharing the launcher's trace id
+        with span("gang-launch", world=np_procs, coordinator=coordinator):
+            record_event("gang-launch", incarnation=0, world=np_procs,
+                         coordinator=coordinator)
+            ctx_env.update(propagation_env())
+            for rank in range(np_procs):
+                procs[rank] = spawn(rank, 0)
 
-        # poll ALL ranks: a sequential wait() in rank order would miss a
-        # higher rank dying first (e.g. rank 1 crashing while rank 0 blocks
-        # in the coordinator handshake forever) and never fail fast
-        live = set(range(np_procs))
-        while live and not rc:
-            for i in sorted(live):
-                code = procs[i].poll()
-                if code is None:
-                    continue
-                if code and restarts[i] < max_restarts:
-                    restarts[i] += 1
-                    print(f"[launcher] rank {i} exited {code}; restarting "
-                          f"(incarnation {restarts[i]}/{max_restarts})",
-                          flush=True)
-                    procs[i] = spawn(i, restarts[i])
-                    continue
-                live.discard(i)
-                if code and not rc:
-                    rc = code
-                    for q in procs.values():  # fail-fast: take survivors down
+            # poll ALL ranks: a sequential wait() in rank order would miss
+            # a higher rank dying first (e.g. rank 1 crashing while rank 0
+            # blocks in the coordinator handshake forever) and never fail
+            # fast
+            live = set(range(np_procs))
+            while live and not rc:
+                for i in sorted(live):
+                    code = procs[i].poll()
+                    if code is None:
+                        continue
+                    if code and restarts[i] < max_restarts:
+                        restarts[i] += 1
+                        print(f"[launcher] rank {i} exited {code}; "
+                              f"restarting (incarnation "
+                              f"{restarts[i]}/{max_restarts})", flush=True)
+                        procs[i] = spawn(i, restarts[i])
+                        continue
+                    live.discard(i)
+                    if code and not rc:
+                        rc = code
+                        # fail-fast: take survivors down
+                        for q in procs.values():
+                            if q.poll() is None:
+                                q.terminate()
+                if (deadline is not None and time.monotonic() > deadline
+                        and live):
+                    print(f"[launcher] timeout after {timeout}s; killing "
+                          f"{len(live)} live rank(s)", flush=True)
+                    rc = 124
+                    for q in procs.values():
                         if q.poll() is None:
                             q.terminate()
-            if deadline is not None and time.monotonic() > deadline and live:
-                print(f"[launcher] timeout after {timeout}s; killing "
-                      f"{len(live)} live rank(s)", flush=True)
-                rc = 124
-                for q in procs.values():
-                    if q.poll() is None:
-                        q.terminate()
-                break
-            if live and not rc:
-                time.sleep(0.05)
+                    break
+                if live and not rc:
+                    time.sleep(0.05)
+        record_event("gang-exit", incarnation=0, rc=rc)
     finally:
         for q in procs.values():
             if q.poll() is None:
                 q.kill()
         for t in pumps:
             t.join(timeout=5)
+        from ..core.trace import flush_sink
+
+        flush_sink()
+        _fleet_exposition([p for p in sink_paths.values() if p])
     return rc
 
 
@@ -198,7 +255,9 @@ def launch_supervised(np_procs: int, cmd: list[str],
     is exhausted (124 for a stall — it is a hang, and the capture layer
     already classifies 124 that way), or 124 on whole-job ``timeout``.
     """
-    from ..core.trace import record_event
+    import contextlib
+
+    from ..core.trace import propagation_env, record_event, span
     from .supervisor import (CKPT_DIR_ENV, CKPT_EVERY_ENV, GangSupervisor,
                              HEARTBEAT_DIR_ENV, HEARTBEAT_INTERVAL_ENV,
                              RESUME_ENV)
@@ -210,21 +269,33 @@ def launch_supervised(np_procs: int, cmd: list[str],
         hb_dir = tempfile.mkdtemp(prefix="cme213_hb_")
     supervisor = GangSupervisor(hb_dir, np_procs, stall_timeout)
     pumps = []
+    sink_paths: dict[int, str | None] = {}
+    # one gang-launch span per incarnation: children parent their root
+    # spans under the incarnation that spawned them, so a merged trace
+    # separates pre- and post-restart causality
+    gang_span = contextlib.ExitStack()
 
     def spawn_gang(incarnation: int) -> dict[int, subprocess.Popen]:
         # fresh coordinator port per incarnation: the previous port may be
         # lingering in TIME_WAIT or held by a not-yet-reaped rank
         coordinator = f"127.0.0.1:{free_port()}"
+        gang_span.close()
+        gang_span.enter_context(
+            span("gang-launch", incarnation=incarnation, world=np_procs,
+                 coordinator=coordinator))
         record_event("gang-launch", incarnation=incarnation,
                      world=np_procs, coordinator=coordinator)
+        ctx_env = propagation_env()
         procs = {}
         for rank in range(np_procs):
             env = dict(os.environ,
                        JAX_COORDINATOR_ADDRESS=coordinator,
                        JAX_NUM_PROCESSES=str(np_procs),
                        JAX_PROCESS_ID=str(rank),
-                       CME213_INCARNATION=str(incarnation))
-            _template_trace_file(env, rank)
+                       CME213_INCARNATION=str(incarnation),
+                       **ctx_env)
+            sink_paths[rank] = _template_trace_file(env, rank)
+            _template_metrics_file(env, rank)
             env[HEARTBEAT_DIR_ENV] = hb_dir
             env[HEARTBEAT_INTERVAL_ENV] = str(heartbeat_interval)
             if ckpt_dir:
@@ -318,8 +389,13 @@ def launch_supervised(np_procs: int, cmd: list[str],
             procs = spawn_gang(incarnation)
     finally:
         kill_gang(procs)
+        gang_span.close()
         for t in pumps:
             t.join(timeout=5)
+        from ..core.trace import flush_sink
+
+        flush_sink()
+        _fleet_exposition([p for p in sink_paths.values() if p])
 
 
 def main(argv=None) -> int:

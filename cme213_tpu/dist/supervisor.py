@@ -103,6 +103,24 @@ def read_heartbeat(hb_dir: str, rank: int) -> dict | None:
         return None
 
 
+def read_all_heartbeats(hb_dir: str) -> dict[int, dict]:
+    """Every rank's latest beat in ``hb_dir``, keyed by rank — discovered
+    by globbing ``rank*.json`` so callers (``top --hb-dir``) need not know
+    the world size.  Unreadable or malformed files are skipped."""
+    import glob
+
+    out: dict[int, dict] = {}
+    for path in sorted(glob.glob(os.path.join(hb_dir, "rank*.json"))):
+        try:
+            with open(path) as f:
+                beat = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(beat, dict) and isinstance(beat.get("rank"), int):
+            out[beat["rank"]] = beat
+    return out
+
+
 def supervised_env_config() -> dict:
     """Checkpoint plumbing the launcher exported for this rank:
     ``{ckpt_dir, ckpt_every, resume}`` (ckpt_dir None when unsupervised)."""

@@ -95,6 +95,18 @@ def _doctor(argv: list[str]) -> int:
     return doctor_cli.main(argv)
 
 
+def _collect(argv: list[str]) -> int:
+    from .core import collector
+
+    return collector.main(argv)
+
+
+def _top(argv: list[str]) -> int:
+    from . import top_cli
+
+    return top_cli.main(argv)
+
+
 WORKLOADS: dict[str, Workload] = {
     w.name: w
     for w in (
@@ -139,6 +151,17 @@ WORKLOADS: dict[str, Workload] = {
                  "unhealthy, --json for the structured report); "
                  "calibrate: roofline cost models vs XLA cost_analysis "
                  "per (op, rung, shape_class)", _doctor),
+        # not a reference workload: the LIVE half of the telemetry story
+        # (the reference only had post-run timing tables, hw5) — tail
+        # per-rank sinks into one merged fleet view while the gang runs
+        Workload("collect", "telemetry", "tail per-rank trace sinks into "
+                 "a live merged fleet view: one-shot state (--once/"
+                 "--json) or a followed merged JSONL stream (--follow)",
+                 _collect),
+        Workload("top", "telemetry", "live fleet console over the "
+                 "collector: per-rank state/step/heartbeat-age rows, "
+                 "fleet gauges, recent events; deterministic --once/"
+                 "--json for CI", _top),
     )
 }
 

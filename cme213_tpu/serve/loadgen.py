@@ -245,6 +245,10 @@ def slo_report(run: dict, before: dict, after: dict, slo=None) -> dict:
     elapsed = run["elapsed_s"]
     sizes = [r.batch_size for r in served if r.batch_size]
     return {
+        # the process-spanning trace id this session's records carry —
+        # inherited from a launcher when run under one, so the report is
+        # joinable against the merged gang trace
+        "trace_id": trace.trace_id(),
         "requests": len(results),
         "served": len(served),
         "shed": len(shed),

@@ -49,6 +49,10 @@ class SolveRequest:
     admitted_s: float | None = None   # cleared the admission preflight
     executed_s: float | None = None   # handed to the kernel ladder
     completed_s: float | None = None  # ladder returned
+    # process-spanning trace id (core/trace): stamped at submit, carried
+    # through queue -> batch -> execution -> result, so one id follows
+    # the request across the loadgen/server process boundary
+    trace_id: str | None = None
 
     def timing(self) -> dict:
         """Phase breakdown in ms (``queue``/``admit``/``batch_wait``/
@@ -80,6 +84,7 @@ class SolveResult:
     degraded: bool = False            # served under degraded mode
     tenant: str = "default"           # principal the request ran under
     timing: dict | None = None        # phase breakdown (SolveRequest.timing)
+    trace_id: str | None = None       # trace the request belonged to
 
     @property
     def ok(self) -> bool:

@@ -57,7 +57,8 @@ from ..core import admission, metrics
 from ..core.errors import FrameworkError
 from ..core.faults import maybe_slow
 from ..core.resilience import CircuitBreaker, Clock, with_fallback
-from ..core.trace import current_span_id, record_event, span
+from ..core.trace import (current_span_id, record_event, span,
+                          trace_id as current_trace_id)
 from .request import (
     ADMISSION,
     DEADLINE,
@@ -153,31 +154,39 @@ class Server:
     # ------------------------------------------------------------ submit
 
     def submit(self, op: str, payload, deadline_ms: float | None = None,
-               tenant: str = "default"):
+               tenant: str = "default", trace_id: str | None = None):
         """Accept (returns the request id) or refuse (returns a SHED
-        :class:`SolveResult`) — never blocks, never queues unboundedly."""
+        :class:`SolveResult`) — never blocks, never queues unboundedly.
+
+        ``trace_id`` joins the request to an existing cross-process trace
+        (a remote caller forwarding its own id); by default the request
+        rides this process's trace, so loadgen → queue → batch →
+        execution → result share one process-spanning id."""
         if op not in self.adapters:
             raise ValueError(f"unknown op {op!r} "
                              f"(serving: {sorted(self.adapters)})")
+        tid = trace_id or current_trace_id()
         metrics.counter("serve.requests").inc()
         metrics.counter(f"serve.tenant.{tenant}.requests").inc()
         now = self.clock.now()
         rid = next(self._rids)
         if deadline_ms is not None and deadline_ms <= 0:
             return self._shed_deadline(
-                SolveRequest(rid, op, payload, now, now, tenant=tenant),
+                SolveRequest(rid, op, payload, now, now, tenant=tenant,
+                             trace_id=tid),
                 late_ms=-deadline_ms, now=now)
         req = SolveRequest(
             rid, op, payload, submitted_s=now,
             deadline_s=None if deadline_ms is None else now + deadline_ms / 1e3,
-            tenant=tenant)
+            tenant=tenant, trace_id=tid)
         if not self.queue.push(req):
             metrics.counter(f"serve.shed.{QUEUE_FULL}").inc()
             metrics.counter(f"serve.tenant.{tenant}.shed").inc()
             record_event("queue-shed", op=op, reason=QUEUE_FULL,
-                         depth=len(self.queue), age_ms=0.0, tenant=tenant)
+                         depth=len(self.queue), age_ms=0.0, tenant=tenant,
+                         trace=req.trace_id)
             res = SolveResult(rid, op, SHED, reason=QUEUE_FULL, tenant=tenant,
-                              timing=req.timing())
+                              timing=req.timing(), trace_id=req.trace_id)
             self._observe_slo(res)
             return res
         return rid
@@ -190,9 +199,10 @@ class Server:
         record_event("deadline-shed", op=req.op, rid=req.rid,
                      late_ms=round(late_ms, 3), depth=len(self.queue),
                      age_ms=round((now - req.submitted_s) * 1e3, 3),
-                     tenant=req.tenant)
+                     tenant=req.tenant, trace=req.trace_id)
         res = SolveResult(req.rid, req.op, SHED, reason=DEADLINE,
-                          tenant=req.tenant, timing=req.timing())
+                          tenant=req.tenant, timing=req.timing(),
+                          trace_id=req.trace_id)
         self._observe_slo(res)
         return res
 
@@ -285,9 +295,10 @@ class Server:
                     record_event("queue-shed", op=r.op, reason=ADMISSION,
                                  depth=len(self.queue),
                                  age_ms=round((now - r.submitted_s) * 1e3, 3),
-                                 tenant=r.tenant)
+                                 tenant=r.tenant, trace=r.trace_id)
                     res = SolveResult(r.rid, r.op, SHED, reason=ADMISSION,
-                                      tenant=r.tenant, timing=r.timing())
+                                      tenant=r.tenant, timing=r.timing(),
+                                      trace_id=r.trace_id)
                     self._observe_slo(res)
                     shed.append(res)
                 return [], shed
@@ -329,12 +340,13 @@ class Server:
                 record_event("request-served", rid=r.rid, op=op,
                              tenant=r.tenant, batch=batch_span,
                              status=FAILED, total_ms=timing["total_ms"],
+                             trace=r.trace_id,
                              **{k: v for k, v in timing.items()
                                 if k != "total_ms"})
                 res_f = SolveResult(
                     r.rid, op, FAILED, reason=str(e)[:200], shape_class=key,
                     batch_size=len(batch), degraded=self.degraded,
-                    tenant=r.tenant, timing=timing)
+                    tenant=r.tenant, timing=timing, trace_id=r.trace_id)
                 self._observe_slo(res_f)
                 out.append(res_f)
             return out
@@ -358,13 +370,14 @@ class Server:
                     metrics.histogram(f"serve.request.{phase}_ms").observe(v)
             record_event("request-served", rid=r.rid, op=op, tenant=r.tenant,
                          batch=batch_span, status=OK,
-                         total_ms=timing["total_ms"],
+                         total_ms=timing["total_ms"], trace=r.trace_id,
                          **{k: v for k, v in timing.items()
                             if k != "total_ms"})
             res_ok = SolveResult(
                 r.rid, op, OK, value=value, rung=res.rung, shape_class=key,
                 latency_ms=latency_ms, batch_size=len(batch),
-                degraded=self.degraded, tenant=r.tenant, timing=timing)
+                degraded=self.degraded, tenant=r.tenant, timing=timing,
+                trace_id=r.trace_id)
             self._observe_slo(res_ok)
             out.append(res_ok)
         metrics.write_exposition()   # no-op unless CME213_METRICS_FILE set

@@ -10,17 +10,22 @@ templating).  Three commands:
   retrace counts, roofline attribution, served-rung and demotion counts,
   checkpoint-commit latency percentiles, fault/retry/rollback tallies,
   gang verdicts.  ``--require a,b`` fails (exit 1) when a named span
-  never completed — the CI smoke gate.  ``--json`` prints the same
-  aggregates as one JSON document (what CI and the regression gate
-  consume instead of scraping text).
+  never completed — the CI smoke gate — and ``--single-trace`` fails
+  unless the records carry exactly one cross-process trace id.
+  ``--json`` prints the same aggregates as one JSON document (what CI
+  and the regression gate consume instead of scraping text).
 - ``timeline`` — one chronological line per event with relative
   timestamps and rank labels (span-begin records are folded into their
-  span-end line; ``--all`` shows everything).
+  span-end line; ``--all`` shows everything).  Both summary and
+  timeline window long-horizon traces with ``--since <ms|ISO>`` and
+  ``--last N``.
 - ``merge``    — interleave many per-rank files into one time-sorted
   JSON-lines stream (stdout or ``--out``); ``--timeline`` renders the
   merged gang view instead — launch, heartbeats, epoch commits, the
   stall/exit verdict, restart, resume — which is how a 2-rank rankkill
-  faultcheck run is reconstructed after the fact.
+  faultcheck run is reconstructed after the fact.  ``--follow`` tails
+  the files live through ``core/collector.py`` instead of one
+  post-mortem pass.
 - ``export``   — convert traces (including ``merge``-style multi-rank
   sets) to Chrome trace-event JSON loadable in Perfetto or
   ``chrome://tracing``: rank → pid, span nesting depth → tid, spans as
@@ -49,6 +54,7 @@ import argparse
 import json
 import os
 import sys
+import time
 from collections import Counter, defaultdict
 
 from .core.metrics import _nearest_rank
@@ -60,7 +66,7 @@ class TraceParseError(ValueError):
 
 
 #: tags every record carries; hidden from per-event detail rendering
-_BASE_FIELDS = {"event", "t", "pid", "rank", "incarnation", "_file"}
+_BASE_FIELDS = {"event", "t", "pid", "rank", "incarnation", "trace", "_file"}
 
 
 def load_events(paths: list[str]) -> list[dict]:
@@ -91,6 +97,38 @@ def load_events(paths: list[str]) -> list[dict]:
 def _rank_label(rec: dict) -> str:
     r = rec.get("rank")
     return f"r{r}" if isinstance(r, int) else "main"
+
+
+def window_events(events: list[dict], since=None,
+                  last: int | None = None) -> list[dict]:
+    """Windowing for long-horizon (collector-era) traces.  ``since``
+    keeps records newer than a bound — a bare number is milliseconds
+    back from the NEWEST record, an ISO-8601 timestamp is absolute;
+    ``last`` keeps the N newest records (after ``since``).  Raises
+    ValueError on an unparseable ``since``."""
+    if since is not None:
+        try:
+            ms = float(since)
+        except (TypeError, ValueError):
+            from datetime import datetime
+
+            try:
+                cutoff = datetime.fromisoformat(str(since)).timestamp()
+            except ValueError as e:
+                raise ValueError(
+                    f"--since {since!r} is neither a millisecond count "
+                    f"nor an ISO-8601 timestamp") from e
+        else:
+            ts = [e["t"] for e in events
+                  if isinstance(e.get("t"), (int, float))]
+            cutoff = (max(ts) - ms / 1e3) if ts else None
+        if cutoff is not None:
+            events = [e for e in events
+                      if isinstance(e.get("t"), (int, float))
+                      and e["t"] >= cutoff]
+    if last is not None:
+        events = events[-last:] if last > 0 else []
+    return events
 
 
 def _error_class(error) -> str:
@@ -128,6 +166,15 @@ def summarize(events: list[dict], out=None) -> dict:
     w(f"{len(events)} events over {span_s:.3f}s, ranks: "
       f"{', '.join(ranks) or '-'}, incarnations: "
       f"{', '.join(str(i) for i in incarnations)}\n")
+
+    # cross-process causality: the trace ids and pids the records span —
+    # a launched gang shows ONE id across every pid it touched
+    trace_ids = sorted({str(e["trace"]) for e in events if e.get("trace")})
+    pids = sorted({e["pid"] for e in events
+                   if isinstance(e.get("pid"), int)})
+    if trace_ids:
+        w(f"trace ids: {', '.join(trace_ids)} "
+          f"(across {len(pids)} pid(s))\n")
 
     invalid = Counter()
     for e in events:
@@ -546,7 +593,8 @@ def summarize(events: list[dict], out=None) -> dict:
           + ", ".join(f"{k} x{n}" for k, n in sorted(faults.items())) + "\n")
 
     # all keys are strings so the dict doubles as the --json document
-    return {"events": len(events), "ranks": ranks, "spans": dict(by_span),
+    return {"events": len(events), "ranks": ranks,
+            "trace_ids": trace_ids, "pids": pids, "spans": dict(by_span),
             "served": {f"{op}.{rung}": n for (op, rung), n in served.items()},
             "rung_failed": {f"{op}.{rung}": n
                             for (op, rung), n in rung_failed.items()},
@@ -614,6 +662,14 @@ def _detail(rec: dict) -> str:
     return " ".join(parts)
 
 
+def _timeline_line(e: dict, t0: float) -> str:
+    t = e.get("t")
+    rel = f"+{t - t0:9.3f}s" if isinstance(t, (int, float)) else " " * 11
+    inc = e.get("incarnation", 0)
+    return (f"{rel} {_rank_label(e):>5} i{inc} "
+            f"{e['event']:<22} {_detail(e)}\n")
+
+
 def render_timeline(events: list[dict], out=None,
                     show_all: bool = False) -> None:
     """One line per event, chronological, relative to the first record —
@@ -624,11 +680,7 @@ def render_timeline(events: list[dict], out=None,
     for e in events:
         if not show_all and e["event"] == "span-begin":
             continue  # folded into the span-end line (which carries ms)
-        t = e.get("t")
-        rel = f"+{t - t0:9.3f}s" if isinstance(t, (int, float)) else " " * 11
-        inc = e.get("incarnation", 0)
-        out.write(f"{rel} {_rank_label(e):>5} i{inc} "
-                  f"{e['event']:<22} {_detail(e)}\n")
+        out.write(_timeline_line(e, t0))
 
 
 # ------------------------------------------------------------------ export
@@ -828,11 +880,22 @@ def main(argv: list[str] | None = None) -> int:
                        help="print the aggregates as one JSON document "
                             "instead of the text report (what CI and the "
                             "regression gate consume)")
+    p_sum.add_argument("--single-trace", action="store_true",
+                       help="exit 1 unless the records carry exactly one "
+                            "trace id — the cross-process propagation gate")
 
     p_tl = sub.add_parser("timeline", help="chronological event listing")
     p_tl.add_argument("files", nargs="+")
     p_tl.add_argument("--all", action="store_true",
                       help="include span-begin records")
+
+    for p in (p_sum, p_tl):
+        p.add_argument("--since", default=None,
+                       help="only records newer than this: a number is "
+                            "milliseconds back from the newest record, "
+                            "else an ISO-8601 timestamp")
+        p.add_argument("--last", type=int, default=None,
+                       help="only the N newest records (after --since)")
 
     p_mg = sub.add_parser("merge", help="interleave per-rank files")
     p_mg.add_argument("files", nargs="+")
@@ -841,6 +904,14 @@ def main(argv: list[str] | None = None) -> int:
                            "JSON lines")
     p_mg.add_argument("--out", default=None,
                       help="write merged JSON lines here (default stdout)")
+    p_mg.add_argument("--follow", action="store_true",
+                      help="keep tailing the files (live collector) "
+                           "instead of one post-mortem pass; globs are "
+                           "re-expanded as ranks appear")
+    p_mg.add_argument("--interval", type=float, default=0.5,
+                      help="seconds between polls in --follow mode")
+    p_mg.add_argument("--max-seconds", type=float, default=None,
+                      help="stop following after this many seconds")
 
     p_ex = sub.add_parser("export", help="Chrome trace-event JSON "
                                          "(Perfetto / chrome://tracing)")
@@ -889,11 +960,49 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         render_flight(doc)
         return 0
+    if args.cmd == "merge" and args.follow:
+        # live mode rides the collector's tailer (rotation/truncation-
+        # safe, partial-line tolerant) instead of the strict parser: a
+        # torn tail line is pending input here, not a corrupt trace
+        from .core.collector import Collector
+
+        coll = Collector(args.files)
+        deadline = (time.monotonic() + args.max_seconds
+                    if args.max_seconds else None)
+        out = open(args.out, "w") if args.out else sys.stdout
+        t0: float | None = None
+        try:
+            while True:
+                for e in coll.poll():
+                    t = e.get("t")
+                    if t0 is None and isinstance(t, (int, float)):
+                        t0 = t
+                    if args.timeline:
+                        out.write(_timeline_line(e, t0 or 0.0))
+                    else:
+                        rec = {k: v for k, v in e.items() if k != "_file"}
+                        out.write(json.dumps(rec, default=str) + "\n")
+                    out.flush()
+                if deadline is not None and time.monotonic() > deadline:
+                    break
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            if args.out:
+                out.close()
+        return 0
     try:
         events = load_events(args.files)
     except (TraceParseError, OSError) as e:
         print(f"trace: {e}", file=sys.stderr)
         return 2
+    if args.cmd in ("summary", "timeline"):
+        try:
+            events = window_events(events, since=args.since, last=args.last)
+        except ValueError as e:
+            print(f"trace: {e}", file=sys.stderr)
+            return 2
 
     if args.cmd == "export":
         doc = to_chrome_trace(events)
@@ -917,6 +1026,12 @@ def main(argv: list[str] | None = None) -> int:
         if missing:
             print(f"trace: required span(s)/event(s) never appeared: "
                   f"{', '.join(missing)}", file=sys.stderr)
+            return 1
+        if args.single_trace and len(agg["trace_ids"]) != 1:
+            print(f"trace: expected exactly one trace id, saw "
+                  f"{len(agg['trace_ids'])} "
+                  f"({', '.join(agg['trace_ids']) or '-'})",
+                  file=sys.stderr)
             return 1
         return 0
     if args.cmd == "timeline":

@@ -1,0 +1,573 @@
+"""Fleet telemetry (ISSUE 13): cross-process trace propagation, the live
+collector, federated metrics, and the ``collect``/``top`` consoles.
+
+The load-bearing assertions: one trace id demonstrably spans processes —
+a supervised 2-rank gang (launcher + both workers + the post-restart
+incarnation) and a plain-launch child both stamp the launcher's id on
+every record — and the collector reconstructs the fleet view LIVE from
+per-rank sinks (rotation/truncation/partial-line tolerant), with the
+federated Prometheus exposition rendering the same per-rank state.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from cme213_tpu.core import faults, metrics, trace
+from cme213_tpu.core.collector import (Collector, SinkTailer,
+                                       write_fleet_exposition)
+from cme213_tpu import top_cli, trace_cli
+from cme213_tpu.core import collector as collector_cli
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    trace.flush_sink()
+    trace.clear_events()
+    metrics.reset()
+    yield
+    trace.flush_sink()
+    trace.clear_events()
+    metrics.reset()
+    faults.reset()
+
+
+# ----------------------------------------------------- context propagation
+
+def test_trace_id_minted_once_and_stable():
+    a = trace.trace_id()
+    assert a and a == trace.trace_id()
+    rec = trace.record_event("heartbeat", rank=0, step=1)
+    assert rec["trace"] == a
+
+
+def test_inherited_context_overrides_local_id(monkeypatch):
+    monkeypatch.setenv(trace.TRACE_CONTEXT_ENV, json.dumps(
+        {"trace_id": "T1", "parent_span_id": "P9"}))
+    assert trace.trace_id() == "T1"
+    assert trace.inherited_parent_id() == "P9"
+    assert trace.record_event("heartbeat", rank=0, step=1)["trace"] == "T1"
+    # a root span parents under the spawning process's open span; nested
+    # spans parent locally as usual
+    with trace.span("root"):
+        with trace.span("inner"):
+            pass
+    begins = trace.events("span-begin")
+    root_b = next(b for b in begins if b["span"] == "root")
+    inner_b = next(b for b in begins if b["span"] == "inner")
+    assert root_b["parent"] == "P9"
+    assert inner_b["parent"] == root_b["id"]
+
+
+def test_malformed_context_falls_back_to_local(monkeypatch):
+    monkeypatch.setenv(trace.TRACE_CONTEXT_ENV, "{not json")
+    tid = trace.trace_id()   # must not raise
+    assert tid and tid == trace.trace_id()
+    assert trace.inherited_parent_id() is None
+
+
+def test_propagation_env_round_trip(monkeypatch):
+    monkeypatch.delenv(trace.TRACE_CONTEXT_ENV, raising=False)
+    with trace.span("launching"):
+        env = trace.propagation_env()
+        ctx = json.loads(env[trace.TRACE_CONTEXT_ENV])
+        assert ctx["trace_id"] == trace.trace_id()
+        assert ctx["parent_span_id"] == trace.current_span_id()
+    # outside any span, an inherited parent is forwarded unchanged
+    monkeypatch.setenv(trace.TRACE_CONTEXT_ENV, json.dumps(
+        {"trace_id": "T1", "parent_span_id": "P9"}))
+    ctx = json.loads(trace.propagation_env()[trace.TRACE_CONTEXT_ENV])
+    assert ctx == {"trace_id": "T1", "parent_span_id": "P9"}
+
+
+def test_subprocess_child_joins_the_trace(monkeypatch):
+    code = ("from cme213_tpu.core import trace; "
+            "print('TID', trace.trace_id(), trace.inherited_parent_id())")
+    monkeypatch.setenv("PYTHONPATH", _REPO)
+    with trace.span("spawn"):
+        env = dict(os.environ, **trace.propagation_env())
+        parent = trace.current_span_id()
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.split() == ["TID", trace.trace_id(), parent]
+
+
+# ----------------------------------------------------- {rank} templating
+
+def test_format_trace_path_units():
+    assert trace.format_trace_path("t-{rank}.jsonl", 3) == "t-3.jsonl"
+    assert trace.format_trace_path("t-{rank}.jsonl", None) == "t-main.jsonl"
+    assert trace.format_trace_path("t-{rank}.jsonl", "") == "t-main.jsonl"
+    assert trace.format_trace_path("flat.jsonl", None) == "flat.jsonl"
+
+
+def test_rank_placeholder_never_reaches_open(tmp_path, monkeypatch):
+    """The env template must resolve even without the launcher — unset,
+    EMPTY, and numeric JAX_PROCESS_ID all yield concrete filenames."""
+    monkeypatch.setenv(trace.TRACE_FILE_ENV, str(tmp_path / "t-{rank}.jsonl"))
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    trace.record_event("heartbeat", rank=0, step=1)
+    monkeypatch.setenv("JAX_PROCESS_ID", "")   # set-but-empty edge
+    trace.record_event("heartbeat", rank=0, step=2)
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    trace.record_event("heartbeat", rank=3, step=3)
+    trace.flush_sink()
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert names == ["t-3.jsonl", "t-main.jsonl"]
+    assert not any("{rank}" in n for n in names)
+    assert len((tmp_path / "t-main.jsonl").read_text().splitlines()) == 2
+
+
+# ------------------------------------------------------------- the tailer
+
+def _line(step, t=1.0, rank=0):
+    return json.dumps({"event": "heartbeat", "t": t, "rank": rank,
+                       "step": step, "pid": 1, "incarnation": 0,
+                       "trace": "T1"}) + "\n"
+
+
+def test_tailer_partial_lines_buffered(tmp_path):
+    p = tmp_path / "s.jsonl"
+    tailer = SinkTailer(str(p))
+    assert tailer.poll() == []                       # not yet created
+    full, torn = _line(1), _line(2, t=2.0)
+    p.write_text(full + torn[:10])                   # torn mid-record
+    assert [r["step"] for r in tailer.poll()] == [1]
+    with open(p, "a") as f:
+        f.write(torn[10:])                           # the rest arrives
+    assert [r["step"] for r in tailer.poll()] == [2]
+    assert tailer.malformed == 0
+
+
+def test_tailer_survives_rotation(tmp_path):
+    p = tmp_path / "s.jsonl"
+    p.write_text(_line(1) + _line(2, t=2.0))
+    tailer = SinkTailer(str(p))
+    assert len(tailer.poll()) == 2
+    fresh = tmp_path / "s.jsonl.new"                 # new inode
+    fresh.write_text(_line(7, t=3.0))
+    os.replace(fresh, p)
+    assert [r["step"] for r in tailer.poll()] == [7]
+
+
+def test_tailer_survives_truncation(tmp_path):
+    p = tmp_path / "s.jsonl"
+    p.write_text(_line(1) + _line(2, t=2.0))
+    tailer = SinkTailer(str(p))
+    assert len(tailer.poll()) == 2
+    p.write_text(_line(9, t=3.0))                    # shrunk in place
+    assert [r["step"] for r in tailer.poll()] == [9]
+
+
+def test_tailer_counts_malformed_lines(tmp_path):
+    p = tmp_path / "s.jsonl"
+    p.write_text("not json\n" + json.dumps({"no_event": 1}) + "\n"
+                 + _line(4))
+    tailer = SinkTailer(str(p))
+    assert [r["step"] for r in tailer.poll()] == [4]
+    assert tailer.malformed == 2
+
+
+# ---------------------------------------------------------- the collector
+
+def _fleet_fixture(tmp_path):
+    """Synthetic launcher + 2-rank sinks shaped like a rankkill run."""
+    launcher = [
+        {"event": "gang-launch", "t": 0.0, "rank": None, "incarnation": 0,
+         "world": 2, "coordinator": "c:1", "pid": 9, "trace": "T1"},
+        {"event": "rank-failed", "t": 3.0, "rank": 1, "incarnation": 0,
+         "reason": "exit", "code": 113, "pid": 9, "trace": "T1"},
+        {"event": "gang-restart", "t": 3.1, "rank": None, "incarnation": 1,
+         "reason": "exit", "pid": 9, "trace": "T1"},
+        {"event": "gang-launch", "t": 3.2, "rank": None, "incarnation": 1,
+         "world": 2, "coordinator": "c:2", "pid": 9, "trace": "T1"},
+        {"event": "gang-exit", "t": 9.0, "rank": None, "incarnation": 1,
+         "rc": 0, "pid": 9, "trace": "T1"},
+    ]
+    r0 = [
+        {"event": "heartbeat", "t": 1.0, "rank": 0, "step": 2, "pid": 10,
+         "incarnation": 0, "trace": "T1"},
+        {"event": "epoch-commit", "t": 2.0, "rank": 0, "epoch": 1,
+         "step": 2, "world": 2, "shards": 2, "ms": 5.0, "pid": 10,
+         "incarnation": 0, "trace": "T1"},
+        {"event": "span-begin", "t": 4.0, "rank": 0, "span": "solve",
+         "id": "a.1", "parent": None, "pid": 12, "incarnation": 1,
+         "trace": "T1"},
+        {"event": "span-end", "t": 6.0, "rank": 0, "span": "solve",
+         "id": "a.1", "parent": None, "ms": 2000.0, "pid": 12,
+         "incarnation": 1, "trace": "T1"},
+        {"event": "metrics-snapshot", "t": 8.0, "rank": 0,
+         "metrics": {"counters": {"fleet.steps": 6}, "gauges": {},
+                     "histograms": {}},
+         "pid": 12, "incarnation": 1, "trace": "T1"},
+    ]
+    r1 = [
+        {"event": "heartbeat", "t": 1.1, "rank": 1, "step": 1, "pid": 11,
+         "incarnation": 0, "trace": "T1"},
+        {"event": "heartbeat", "t": 7.0, "rank": 1, "step": 5, "pid": 13,
+         "incarnation": 1, "trace": "T1"},
+    ]
+    paths = []
+    for name, recs in (("f-main.jsonl", launcher), ("f-0.jsonl", r0),
+                       ("f-1.jsonl", r1)):
+        p = tmp_path / name
+        p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        paths.append(str(p))
+    return paths
+
+
+def test_collector_merges_and_aggregates(tmp_path):
+    paths = _fleet_fixture(tmp_path)
+    coll = Collector([str(tmp_path / "f-*.jsonl")])  # glob form
+    batch = coll.poll()
+    assert [r["t"] for r in batch] == sorted(r["t"] for r in batch)
+    st = coll.state()
+    assert st["trace_ids"] == ["T1"]
+    assert list(st["ranks"]) == ["r0", "r1", "main"]
+    assert st["fleet"] == {"exits": 1, "launches": 2, "restarts": 1,
+                           "verdicts": 1, "commits": 1}
+    assert st["verdicts"] == [{"rank": 1, "reason": "exit",
+                               "incarnation": 0, "t": 3.0}]
+    # rank-failed comes from the LAUNCHER: r1's pid stays the worker's,
+    # and the incarnation-1 heartbeat clears the failed state
+    r1 = st["ranks"]["r1"]
+    assert r1["pid"] == 13 and r1["state"] == "running" and r1["step"] == 5
+    assert r1["incarnation"] == 1
+    # ages are relative to the NEWEST observed event (t=9.0), not wall
+    # clock — deterministic for --once --json
+    assert r1["heartbeat_age_s"] == 2.0
+    assert st["ranks"]["main"]["pid"] == 9 and st["last_rc"] == 0
+    assert st["spans"]["solve"] == {"count": 1, "total_ms": 2000.0,
+                                    "max_ms": 2000.0}
+    assert st["commit_lag_s"] == 7.0
+    assert coll.fleet_snapshots() == {
+        "r0": {"counters": {"fleet.steps": 6}, "gauges": {},
+               "histograms": {}}}
+    # incremental: nothing new -> empty batch, state unchanged
+    assert coll.poll() == [] and coll.state()["events"] == st["events"]
+
+
+def test_collect_cli_once_json_and_text(tmp_path, capsys):
+    paths = _fleet_fixture(tmp_path)
+    assert collector_cli.main([*paths, "--once", "--json"]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["trace_ids"] == ["T1"] and set(st["ranks"]) == {
+        "r0", "r1", "main"}
+    assert collector_cli.main([*paths, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "3 proc(s)" in out and "1 trace id(s)" in out
+    assert "verdict: rank 1 exit" in out
+
+
+def test_collect_cli_follow_streams_jsonl(tmp_path, capsys):
+    paths = _fleet_fixture(tmp_path)
+    assert collector_cli.main(
+        [*paths, "--follow", "--interval", "0.01",
+         "--max-seconds", "0.05"]) == 0
+    lines = capsys.readouterr().out.splitlines()
+    recs = [json.loads(ln) for ln in lines]
+    assert len(recs) == 12 and all("_file" not in r for r in recs)
+    assert [r["t"] for r in recs] == sorted(r["t"] for r in recs)
+
+
+# ------------------------------------------------------------ top console
+
+def test_top_once_json_and_text(tmp_path, capsys):
+    paths = _fleet_fixture(tmp_path)
+    assert top_cli.main([*paths, "--once", "--json"]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["fleet"]["restarts"] == 1 and "r1" in st["ranks"]
+    assert top_cli.main([*paths, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "cme213 fleet" in out and "trace T1" in out
+    assert "PROC" in out and "HB AGE" in out
+    assert "restarts=1" in out and "solve" in out
+
+
+def test_top_folds_supervisor_heartbeats(tmp_path, capsys):
+    from cme213_tpu.dist.supervisor import HeartbeatWriter, \
+        read_all_heartbeats
+
+    HeartbeatWriter(str(tmp_path), rank=0).beat(4)
+    HeartbeatWriter(str(tmp_path), rank=1).beat(9)
+    assert {r: b["step"] for r, b in read_all_heartbeats(
+        str(tmp_path)).items()} == {0: 4, 1: 9}
+    sink = tmp_path / "s.jsonl"
+    sink.write_text(_line(None, t=1.0, rank=0).replace('"step": null', '"x": 0'))
+    assert top_cli.main([str(sink), "--once", "--json",
+                         "--hb-dir", str(tmp_path)]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["heartbeats"]["1"]["step"] == 9
+    assert st["ranks"]["r0"]["step"] == 4   # folded from the beat file
+
+
+# ------------------------------------------------------- federated metrics
+
+def test_merge_snapshots_folds_ranks():
+    a = {"counters": {"c": 2, "only_a": 1}, "gauges": {"g": 1.0, "s": "x"},
+         "histograms": {"h": {"count": 2, "sum": 3.0, "min": 1.0,
+                              "max": 2.0, "mean": 1.5, "p50": 1.0,
+                              "p90": 2.0, "p99": 2.0}}}
+    b = {"counters": {"c": 3}, "gauges": {"g": 4.0},
+         "histograms": {"h": {"count": 1, "sum": 9.0, "min": 9.0,
+                              "max": 9.0, "mean": 9.0, "p50": 9.0,
+                              "p90": 9.0, "p99": 9.0}}}
+    m = metrics.merge_snapshots({"r0": a, "r1": b})
+    assert m["counters"] == {"c": 5, "only_a": 1}
+    assert m["gauges"] == {"g": 4.0}            # fleet max; strings dropped
+    h = m["histograms"]["h"]
+    assert h["count"] == 3 and h["sum"] == 12.0
+    assert h["min"] == 1.0 and h["max"] == 9.0
+    assert h["p50"] == 9.0                      # per-rank max upper bound
+    assert h["mean"] == 4.0
+    assert m["ranks"] == ["r0", "r1"]
+
+
+def test_render_prometheus_fleet_labels_and_rollup():
+    metrics.counter("serve.shed.queue-full").inc(2)
+    metrics.gauge("depth").set(3)
+    metrics.histogram("lat.ms").observe(4.0)
+    snap = metrics.snapshot()
+    metrics.reset()
+    text = metrics.render_prometheus(fleet={"r0": snap, "r1": snap})
+    assert "# HELP cme213_serve_shed_total" in text
+    # unlabeled rollup first, then per-rank labeled series
+    assert 'cme213_serve_shed_total{reason="queue-full"} 4' in text
+    assert ('cme213_serve_shed_total{reason="queue-full",rank="r0"} 2'
+            in text)
+    assert 'cme213_depth{rank="r1"} 3' in text and "cme213_depth 3" in text
+    assert 'cme213_lat_ms{quantile="0.5",rank="r0"} 4.0' in text
+    assert "cme213_lat_ms_count 2" in text      # rollup sums counts
+    assert 'cme213_lat_ms_count{rank="r1"} 1' in text
+
+
+def test_write_fleet_exposition_pins_the_file(tmp_path, monkeypatch):
+    dest = tmp_path / "fleet.prom"
+    monkeypatch.setenv(metrics.METRICS_FILE_ENV, str(dest))
+    sink = tmp_path / "s.jsonl"
+    sink.write_text(json.dumps(
+        {"event": "metrics-snapshot", "t": 1.0, "rank": 0,
+         "metrics": {"counters": {"steps": 5}, "gauges": {},
+                     "histograms": {}}}) + "\n")
+    metrics.counter("launcher.polls").inc(7)
+    assert write_fleet_exposition(
+        [str(sink)], extra={"launcher": metrics.snapshot()}) == str(dest)
+    text = dest.read_text()
+    assert 'cme213_steps_total{rank="r0"} 5' in text
+    assert 'cme213_launcher_polls_total{rank="launcher"} 7' in text
+    # the atexit single-process writer must NOT clobber the fleet file
+    metrics._emit_exit_snapshot()
+    assert 'rank="r0"' in dest.read_text()
+
+
+# --------------------------------------------------- serve trace stamping
+
+class _Echo:
+    op = "echo"
+
+    def shape_class(self, payload, coarse=False):
+        return "any"
+
+    def rungs(self, degraded=False):
+        return ("fast",)
+
+    def run_batch(self, payloads, rung, coarse=False):
+        return list(payloads)
+
+    def preflight_builder(self, payloads, rung, coarse=False):
+        return None
+
+
+def test_server_stamps_trace_ids():
+    from cme213_tpu.core.resilience import VirtualClock
+    from cme213_tpu.serve import Server
+
+    server = Server(adapters={"echo": _Echo()}, clock=VirtualClock())
+    rid = server.submit("echo", 1)
+    res = server.drain()[0]
+    assert res.rid == rid and res.trace_id == trace.trace_id()
+    assert trace.events("request-served")[-1]["trace"] == trace.trace_id()
+    # an explicit id (remote caller) is carried end to end, sheds included
+    res2 = server.submit("echo", 2, deadline_ms=0, trace_id="remote-7")
+    assert res2.status == "shed" and res2.trace_id == "remote-7"
+    assert trace.events("deadline-shed")[-1]["trace"] == "remote-7"
+
+
+def test_loadgen_report_carries_trace_id():
+    from cme213_tpu.serve.loadgen import slo_report
+
+    snap = metrics.snapshot()
+    report = slo_report({"results": [], "elapsed_s": 1.0}, snap, snap)
+    assert report["trace_id"] == trace.trace_id()
+
+
+# --------------------------------------------------------- CLI windowing
+
+def _windowed_file(tmp_path):
+    p = tmp_path / "w.jsonl"
+    recs = [{"event": "heartbeat", "t": float(t), "rank": 0, "step": i,
+             "pid": 1, "incarnation": 0, "trace": "T1"}
+            for i, t in enumerate((100.0, 200.0, 300.0))]
+    p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return str(p), recs
+
+
+def test_window_events_units(tmp_path):
+    _, recs = _windowed_file(tmp_path)
+    assert [e["step"] for e in trace_cli.window_events(recs,
+                                                      since="150000")] \
+        == [1, 2]                       # 150s back from the newest (300)
+    from datetime import datetime
+
+    iso = datetime.fromtimestamp(200.0).isoformat()
+    assert [e["step"] for e in trace_cli.window_events(recs, since=iso)] \
+        == [1, 2]
+    assert [e["step"] for e in trace_cli.window_events(recs, last=1)] == [2]
+    assert trace_cli.window_events(recs, last=0) == []
+    with pytest.raises(ValueError):
+        trace_cli.window_events(recs, since="yesterday-ish")
+
+
+def test_cli_since_last_and_single_trace(tmp_path, capsys):
+    path, _ = _windowed_file(tmp_path)
+    assert trace_cli.main(["timeline", path, "--last", "1"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("heartbeat") == 1 and "step=2" in out
+    assert trace_cli.main(["summary", path, "--since", "150000"]) == 0
+    assert "2 events" in capsys.readouterr().out
+    assert trace_cli.main(["summary", path, "--since", "garbage"]) == 2
+    capsys.readouterr()
+    # --single-trace: one id passes, a second id fails
+    assert trace_cli.main(["summary", path, "--single-trace"]) == 0
+    with open(path, "a") as f:
+        f.write(json.dumps({"event": "heartbeat", "t": 400.0, "rank": 1,
+                            "step": 9, "pid": 2, "incarnation": 0,
+                            "trace": "T2"}) + "\n")
+    assert trace_cli.main(["summary", path, "--single-trace"]) == 1
+    assert "expected exactly one trace id" in capsys.readouterr().err
+
+
+def test_cli_merge_follow_streams(tmp_path, capsys):
+    paths = _fleet_fixture(tmp_path)
+    assert trace_cli.main(
+        ["merge", "--follow", *paths, "--interval", "0.01",
+         "--max-seconds", "0.05"]) == 0
+    recs = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()]
+    assert len(recs) == 12 and all("_file" not in r for r in recs)
+    assert trace_cli.main(
+        ["merge", "--follow", "--timeline", *paths, "--interval", "0.01",
+         "--max-seconds", "0.05"]) == 0
+    out = capsys.readouterr().out
+    assert "gang-launch" in out and "rank-failed" in out
+
+
+def test_summary_reports_trace_ids_and_pids(tmp_path, capsys):
+    paths = _fleet_fixture(tmp_path)
+    import io
+
+    agg = trace_cli.summarize(trace_cli.load_events(paths),
+                              out=io.StringIO())
+    assert agg["trace_ids"] == ["T1"]
+    assert agg["pids"] == [9, 10, 11, 12, 13]
+
+
+# ------------------------------------------------------------- end to end
+
+_GANG_WORKER = textwrap.dedent("""
+    import sys, time
+    sys.path.insert(0, {repo!r})
+    from cme213_tpu.core import faults, metrics, trace
+    from cme213_tpu.dist.supervisor import heartbeat_from_env
+
+    hb = heartbeat_from_env()
+    metrics.counter("fleet.steps")        # arm the exit snapshot
+    with trace.span("fleet.worker"):
+        for step in range(6):
+            hb.beat(step)
+            faults.maybe_kill_rank(step)
+            metrics.counter("fleet.steps").inc()
+            time.sleep(0.05)
+""")
+
+
+def test_supervised_gang_shares_one_trace_id(tmp_path, monkeypatch, capsys):
+    """The acceptance path: launcher + both ranks + the post-restart
+    incarnation all stamp ONE trace id; worker root spans parent under
+    the launcher's gang-launch span; the collector and the federated
+    exposition reconstruct the same fleet."""
+    from cme213_tpu.dist.launch import launch_supervised
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_GANG_WORKER.format(repo=_REPO))
+    monkeypatch.setenv(trace.TRACE_FILE_ENV,
+                       str(tmp_path / "gang-{rank}.jsonl"))
+    monkeypatch.setenv(metrics.METRICS_FILE_ENV,
+                       str(tmp_path / "fleet.prom"))
+    monkeypatch.setenv("CME213_FAULTS", "rankkill:1:2")
+    rc = launch_supervised(2, [sys.executable, str(worker)],
+                           stall_timeout=60, max_restarts=1, timeout=240)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    trace.flush_sink()
+
+    files = sorted(tmp_path.glob("gang-*.jsonl"))
+    assert [f.name for f in files] == ["gang-0.jsonl", "gang-1.jsonl",
+                                       "gang-main.jsonl"]
+    recs = [json.loads(ln) for f in files
+            for ln in f.read_text().splitlines()]
+    ids = {r.get("trace") for r in recs}
+    assert ids == {trace.trace_id()}, ids          # ONE id, this process's
+    pids = {r["pid"] for r in recs}
+    assert len(pids) >= 4                          # launcher + 2x2 workers
+    assert {r["incarnation"] for r in recs} >= {0, 1}
+
+    # causal parenting: every worker root span hangs off a gang-launch
+    gang_spans = {r["id"] for r in recs
+                  if r["event"] == "span-begin" and r["span"] == "gang-launch"}
+    worker_roots = [r for r in recs if r["event"] == "span-begin"
+                    and r["span"] == "fleet.worker"]
+    assert len(gang_spans) == 2 and len(worker_roots) >= 3
+    assert all(r["parent"] in gang_spans for r in worker_roots)
+
+    coll = Collector([str(tmp_path / "gang-*.jsonl")])
+    coll.poll()
+    st = coll.state()
+    assert st["fleet"]["launches"] == 2 and st["fleet"]["restarts"] == 1
+    assert st["verdicts"][0]["rank"] == 1
+    assert st["ranks"]["r0"]["state"] == "running"
+    assert st["ranks"]["r1"]["incarnation"] == 1
+
+    # the merged stream passes the CI gate form
+    capsys.readouterr()
+    assert trace_cli.main(
+        ["summary", *[str(f) for f in files], "--single-trace",
+         "--require", "gang-launch,heartbeat"]) == 0
+
+    # federated exposition: both ranks labeled, launcher rolled in
+    prom = (tmp_path / "fleet.prom").read_text()
+    assert 'rank="r0"' in prom and 'rank="r1"' in prom
+    assert "# HELP" in prom
+
+
+def test_plain_launch_propagates_context(tmp_path, monkeypatch, capsys):
+    """The loadgen-shaped path: a plain (unsupervised) launch child
+    inherits the launcher's trace id, and the launcher records the
+    gang-launch/gang-exit lifecycle."""
+    from cme213_tpu.dist.launch import launch
+
+    code = ("from cme213_tpu.core import trace; "
+            "print('CHILD', trace.trace_id())")
+    monkeypatch.setenv("PYTHONPATH", _REPO)
+    rc = launch(1, [sys.executable, "-c", code], timeout=120)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert f"CHILD {trace.trace_id()}" in out
+    assert trace.events("gang-launch")[-1]["world"] == 1
+    assert trace.events("gang-exit")[-1]["rc"] == 0

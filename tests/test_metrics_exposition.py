@@ -80,6 +80,7 @@ def test_percentile_ring_wraparound_past_keep():
 
 _TYPE_LINE = re.compile(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
                         r"(counter|gauge|summary)$")
+_HELP_LINE = re.compile(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
 _SAMPLE_LINE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
     r'(\{[a-zA-Z0-9_]+="(?:[^"\\]|\\.)*"'
@@ -88,11 +89,12 @@ _SAMPLE_LINE = re.compile(
 
 
 def _validate(text):
-    """Prometheus text-format validator: every line is a TYPE line or a
-    ``name{labels} value`` sample with a float-parseable value."""
+    """Prometheus text-format validator: every line is a HELP line, a
+    TYPE line, or a ``name{labels} value`` sample with a float-parseable
+    value."""
     samples = {}
     for line in text.rstrip("\n").split("\n"):
-        if _TYPE_LINE.match(line):
+        if _TYPE_LINE.match(line) or _HELP_LINE.match(line):
             continue
         m = _SAMPLE_LINE.match(line)
         assert m, f"malformed exposition line: {line!r}"
@@ -137,6 +139,22 @@ def test_render_prometheus_escapes_label_values():
     metrics.counter('serve.shed.we"ird\\reason').inc()
     samples = _validate(render_prometheus())
     assert samples['cme213_serve_shed_total{reason="we\\"ird\\\\reason"}'] == 1
+
+
+def test_render_prometheus_help_lines_cover_every_family():
+    metrics.counter("serve.batches").inc()
+    metrics.gauge("depth").set(3)
+    metrics.histogram("lat.ms").observe(1.0)
+    lines = render_prometheus().splitlines()
+    typed = {ln.split()[2] for ln in lines if ln.startswith("# TYPE ")}
+    helped = {ln.split()[2] for ln in lines if ln.startswith("# HELP ")}
+    assert typed and typed == helped
+    for fam in typed:  # HELP immediately precedes its TYPE line
+        ti = next(i for i, ln in enumerate(lines)
+                  if ln.startswith(f"# TYPE {fam} "))
+        assert lines[ti - 1].startswith(f"# HELP {fam} ")
+    # compat shim for consumers that reject comment chatter
+    assert "# HELP" not in render_prometheus(help_lines=False)
 
 
 def test_render_prometheus_empty_registry_and_explicit_snapshot():
