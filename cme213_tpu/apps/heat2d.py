@@ -194,6 +194,7 @@ def run_heat_checkpointed(params: SimParams, path: str, every: int = 0,
     """
     from ..core import admission
     from ..core.checkpoint import run_with_checkpoints
+    from ..core.numerics import ConvergenceTracker
     from ..core.resilience import all_finite
 
     u0 = make_initial_grid(params, dtype=jnp.float32)
@@ -208,10 +209,15 @@ def run_heat_checkpointed(params: SimParams, path: str, every: int = 0,
         return {"grid": run_heat(jnp.asarray(state["grid"]), k,
                                  params.order, params.xcfl, params.ycfl)}
 
+    # diffusion decays monotonically toward steady state, so a residual
+    # flat for 3 chunks already means the solve is burning iterations
+    # for nothing — a tighter stall policy than the generic default
     out = run_with_checkpoints(step, {"grid": u0}, params.iters, path,
                                every=every, guard=all_finite, op="heat2d",
                                max_retries=max_retries,
-                               chunk_op="heat_chunk")
+                               chunk_op="heat_chunk",
+                               tracker=ConvergenceTracker(
+                                   "heat2d", stall_epochs=3))
     return np.asarray(out["grid"])
 
 

@@ -178,7 +178,8 @@ def save_state_checkpoint(path: str, step: int, state) -> None:
 
 def run_with_checkpoints(step_fn, state, total_iters: int, path: str,
                          every: int = 0, guard=None, op: str = "run",
-                         max_retries: int = 1, chunk_op: str | None = None):
+                         max_retries: int = 1, chunk_op: str | None = None,
+                         tracker=None):
     """Drive ``state = step_fn(state, k_iters)`` in checkpointed chunks,
     resuming from ``path`` if a checkpoint exists.
 
@@ -190,6 +191,10 @@ def run_with_checkpoints(step_fn, state, total_iters: int, path: str,
     checkpoint, and the chunk is retried up to ``max_retries`` times before
     ``NonFiniteError`` is raised.  ``op`` names this solve for fault
     injection (``nan:<op>:<nth>`` poisons the Nth chunk) and trace events.
+    Every accepted chunk feeds a ``core.numerics.ConvergenceTracker``
+    (one ``solver-progress`` event per chunk: residual, delta-norm,
+    iterations/s); pass ``tracker`` to tune the stall policy or read the
+    STALLED verdict back after the solve.
 
     **Memory-aware degradation**: a chunk that dies RESOURCE-classified
     (an HBM ``RESOURCE_EXHAUSTED``, real or injected via
@@ -201,8 +206,12 @@ def run_with_checkpoints(step_fn, state, total_iters: int, path: str,
     emits a ``chunk-shrunk`` event; a RESOURCE failure at chunk length 1
     re-raises (no smaller program exists).
     """
+    import time
+
     from . import flight
     from .faults import maybe_oom, maybe_poison
+    from .numerics import (ConvergenceTracker, progress_from_states,
+                           state_snapshot)
     from .resilience import FailureKind, NonFiniteError, classify_failure
 
     # a checkpointed solve is a *long* solve: arm the flight recorder
@@ -221,8 +230,20 @@ def run_with_checkpoints(step_fn, state, total_iters: int, path: str,
     every = every or total_iters
     it = start
     retries = 0
+    # convergence tracing: one solver-progress event per accepted chunk
+    # (residual = relative state change), so a stalling long solve is
+    # visible in `trace summary` / `top` before it wastes its budget.
+    # Callers pass their own ConvergenceTracker to tune the stall policy
+    # (and to read the verdict back after the solve).
+    if tracker is None:
+        tracker = ConvergenceTracker(op)
     while it < total_iters:
         k = min(every, total_iters - it)
+        # snapshot before the chunk: step programs may donate (delete)
+        # their input buffers, so this host copy is the only pre-chunk
+        # state the convergence residual can be measured against
+        prev = state_snapshot(state)
+        t0 = time.perf_counter()
         try:
             maybe_oom(chunk_op)
             with span("checkpoint.chunk", op=op, start=it, iters=k):
@@ -260,6 +281,8 @@ def run_with_checkpoints(step_fn, state, total_iters: int, path: str,
             record_event("checkpoint-rollback", op=op, resumed_step=it,
                          retries=retries)
             continue
+        progress_from_states(tracker, it + k, prev, new_state, k,
+                             time.perf_counter() - t0)
         state = new_state
         it += k
         with span("checkpoint.save", op=op, step=it):

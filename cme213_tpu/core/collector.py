@@ -135,6 +135,10 @@ class Collector:
         self.fleet: collections.Counter = collections.Counter()
         self.spans: dict[str, dict] = {}
         self.verdicts: list[dict] = []
+        #: per-op convergence rows from solver-progress events (the
+        #: STALLED verdict `top` renders; same policy as
+        #: core/numerics.ConvergenceTracker's default)
+        self.solvers: dict[str, dict] = {}
         self.recent: collections.deque = collections.deque(maxlen=64)
         self.last_commit: dict | None = None
         self.last_rc = None
@@ -225,6 +229,20 @@ class Collector:
             row["breakers_open"] = max(0, row["breakers_open"] - 1)
         elif event == "request-served":
             self.fleet["requests"] += 1
+        elif event == "conformance-failed":
+            self.fleet["conformance_failures"] += 1
+        elif event == "attribution-mismatch":
+            self.fleet["attribution_mismatches"] += 1
+        elif event == "numeric-drift":
+            self.fleet["drift_samples"] += 1
+            if rec.get("over_budget"):
+                self.fleet["drift_over_budget"] += 1
+        elif event == "drift-budget-burn":
+            self.fleet["drift_demotions"] += 1
+        elif event == "numeric-sentinel":
+            self.fleet["sentinel_trips"] += 1
+        elif event == "solver-progress":
+            self._ingest_progress(rec)
         elif event == "served" and rec.get("demoted"):
             row["degraded"] = True
         elif event == "flight-dump":
@@ -244,8 +262,32 @@ class Collector:
             if isinstance(rec.get("metrics"), dict):
                 row["metrics"] = rec["metrics"]
 
-        if event not in ("span-begin", "span-end", "heartbeat"):
+        if event not in ("span-begin", "span-end", "heartbeat",
+                         "solver-progress"):
             self.recent.append({"t": t, "rank": key, "event": event})
+
+    #: solver-progress stall policy (matches ConvergenceTracker defaults)
+    _STALL_EPOCHS = 5
+    _MIN_IMPROVE = 1e-3
+
+    def _ingest_progress(self, rec: dict) -> None:
+        op = str(rec.get("op") or "solver")
+        res = rec.get("residual")
+        if not isinstance(res, (int, float)):
+            return
+        row = self.solvers.setdefault(op, {
+            "step": None, "residual": None, "iters_per_s": None,
+            "best": None, "since_improve": 0, "stalled": False})
+        row["step"] = rec.get("step")
+        row["residual"] = res
+        row["iters_per_s"] = rec.get("iters_per_s")
+        best = row["best"]
+        if best is None or res < best * (1.0 - self._MIN_IMPROVE):
+            row["best"] = res
+            row["since_improve"] = 0
+        else:
+            row["since_improve"] += 1
+        row["stalled"] = row["since_improve"] >= self._STALL_EPOCHS
 
     # ------------------------------------------------------------- output
 
@@ -274,6 +316,7 @@ class Collector:
             "ranks": ranks_out,
             "fleet": dict(sorted(self.fleet.items())),
             "verdicts": list(self.verdicts),
+            "solvers": {k: dict(v) for k, v in sorted(self.solvers.items())},
             "spans": {k: dict(v) for k, v in sorted(self.spans.items())},
             "recent": list(self.recent),
             "last_rc": self.last_rc,

@@ -47,6 +47,17 @@ Spec grammar (comma-separated clauses)::
                                   conformance gate (``core/conformance.py``)
                                   exists to catch; first incarnation only,
                                   like rankkill
+    drift:<op>[:<scale>[:<nth>]]  every call of ``maybe_drift(op, v)`` from
+                                  the <nth> (1-based, default 1) onward
+                                  returns ``v`` with every float leaf
+                                  scaled by ``1 + <scale>`` (default 1e-3)
+                                  — a *small* relative error, below the
+                                  ``wrong:`` blow-up, that only the shadow
+                                  conformance sampler (``core/numerics.py``)
+                                  can see; persistent (a drifted kernel
+                                  stays drifted) so the drift error budget
+                                  deterministically burns; first
+                                  incarnation only, like ``wrong:``
     oom:<op>[:<nth>]              the <nth> call of ``maybe_oom(op)`` raises
                                   a synthetic RESOURCE_EXHAUSTED
                                   (``InjectedResourceExhausted``) — the HBM
@@ -121,12 +132,12 @@ class FaultSpecError(ValueError):
 @dataclass
 class _Clause:
     kind: str           # fail | nan | ckpt | rankkill | wrong | oom | slow
-                        # | unreachable | stage
+                        # | unreachable | stage | drift
     op: str             # op name ("truncate" for ckpt; rank id for rankkill;
                         # "*" for the op-agnostic unreachable)
     nth: int = 1        # 1-based trigger call (rankkill: 0-based step)
     count: int = 1      # consecutive triggered calls (fail/slow/unreachable)
-    ms: float = 0.0     # injected latency (slow only)
+    ms: float = 0.0     # injected latency (slow) / relative scale (drift)
     stage: str = ""     # dispatch stage (stage only)
     calls: int = 0      # mutable per-clause call counter
 
@@ -150,11 +161,12 @@ class FaultPlan:
             parts = raw.split(":")
             kind = parts[0]
             if (kind not in ("fail", "nan", "ckpt", "rankkill", "wrong",
-                             "oom", "slow", "unreachable", "stage")
+                             "oom", "slow", "unreachable", "stage", "drift")
                     or len(parts) < 2):
                 raise FaultSpecError(
                     f"bad fault clause {raw!r} (kinds: fail:<op>[:nth[:count]]"
                     f", nan:<op>[:nth], wrong:<op>[:nth], oom:<op>[:nth], "
+                    f"drift:<op>[:scale[:nth]], "
                     f"slow:<op>[:ms[:nth[:count]]], ckpt:truncate[:nth], "
                     f"rankkill:<rank>[:step], unreachable:<nth>[:count], "
                     f"stage:<op>:<stage>[:nth[:count]])")
@@ -189,6 +201,17 @@ class FaultPlan:
                         kind, parts[1], stage=parts[2],
                         nth=int(parts[3]) if len(parts) > 3 else 1,
                         count=int(parts[4]) if len(parts) > 4 else 1))
+                elif kind == "drift":
+                    scale = float(parts[2]) if len(parts) > 2 else 1e-3
+                    if not scale > 0:
+                        raise FaultSpecError(
+                            f"drift clause needs scale > 0, got {scale}")
+                    # persistent from <nth> onward: a drifted kernel stays
+                    # drifted, so the shadow sampler's budget can burn
+                    clauses.append(_Clause(
+                        kind, parts[1], ms=scale,
+                        nth=int(parts[3]) if len(parts) > 3 else 1,
+                        count=1 << 30))
                 elif kind in ("nan", "wrong", "oom"):
                     clauses.append(_Clause(
                         kind, parts[1],
@@ -327,6 +350,43 @@ def maybe_perturb(op: str, value):
             leaves[i] = arr
             _record("wrong", op, leaf=i)
             break
+    return treedef.unflatten(leaves) if treedef is not None else leaves[0]
+
+
+def maybe_drift(op: str, value):
+    """Scale every float leaf of ``value`` by ``1 + scale`` if a
+    ``drift:<op>`` clause covers this call — the *small* silent error a
+    one-shot conformance probe misses but continuous shadow sampling
+    (``core/numerics.py``) catches.  Unlike ``wrong:`` (one element,
+    large), drift perturbs whole leaves by a relative amount well below
+    the blow-up threshold, and the clause is persistent (every call from
+    ``nth`` onward), so the drift error budget burns deterministically.
+    First incarnation only, so a restarted gang serves clean.  Returns
+    ``value`` unchanged when no clause fires; never mutates device
+    buffers."""
+    plan = active()
+    if plan is None:
+        return value
+    fired = [c for c in plan._matching("drift", op) if c.fires()]
+    if not fired or incarnation() != 0:
+        return value
+    scale = fired[0].ms
+    import numpy as np
+
+    try:
+        from jax import tree_util
+        leaves, treedef = tree_util.tree_flatten(value)
+    except ImportError:  # pragma: no cover - jax always present here
+        leaves, treedef = [value], None
+    touched = 0
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and arr.size:
+            # host copy; never mutate a device buffer
+            leaves[i] = (np.array(arr) * (1.0 + scale)).astype(arr.dtype)
+            touched += 1
+    if touched:
+        _record("drift", op, leaves=touched, scale=scale)
     return treedef.unflatten(leaves) if treedef is not None else leaves[0]
 
 

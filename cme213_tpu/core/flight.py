@@ -123,6 +123,13 @@ def dump(reason: str, exc: BaseException | None = None) -> str | None:
             forensics = diag.forensics_state()
         except Exception:  # noqa: BLE001
             health, forensics = None, None
+        # last drift-budget snapshot (core/numerics.py) — same
+        # best-effort contract as the diag imports above
+        try:
+            from . import numerics
+            numeric = numerics.last_drift() or None
+        except Exception:  # noqa: BLE001
+            numeric = None
         events = trace.events()[-DUMP_EVENTS:]
         doc = {
             "flight": 1,
@@ -137,6 +144,7 @@ def dump(reason: str, exc: BaseException | None = None) -> str | None:
             "open_spans": _open_spans(events),
             "health": health,
             "forensics": forensics,
+            "numerics": numeric,
             "events": events,
             "metrics": metrics.snapshot(),
         }

@@ -1,0 +1,462 @@
+"""Numeric-health observatory — continuous correctness as a served signal.
+
+The reference course verified numerics *offline*: hw2 diffed the
+``grid_final_*`` grids after the run, hw_final printed one relative-error
+number per matrix.  Our production path checks a rung exactly once
+(``core/conformance.py``'s first-use probe) and then serves it blind — a
+rung that drifts after warmup, a slow NaN creep in a long solve, or a
+stalling iteration count would never surface in any span, metric, or
+SLO.  This module keeps the check **on** for the life of the process:
+
+- **Shadow conformance sampling** — the serve batcher re-executes a
+  deterministic 1-in-N sample of served requests (``CME213_SHADOW_RATE``,
+  seeded per trace id so every rank of a gang samples the *same*
+  requests) against the op's reference rung, off the hot path, and
+  records the measured rel-L2 / max-ulp drift as ``numeric-drift``
+  events and ``numerics.drift.<op>.<rung>`` histograms.
+- **Drift error budget** — per (op, rung), the same two-window burn
+  machinery as ``serve/slo.py`` (short window proves it is still
+  happening, long window proves it is sustained; hysteresis on
+  recovery), but over *sample counts* instead of wall-clock windows so
+  the budget is deterministic under CI load.  A burned budget demotes
+  the rung through the existing ``with_fallback`` ladder: the server
+  passes :func:`demoted` as the ladder ``gate``, so a drifting rung is
+  routed around with ``FailureKind.WRONG_ANSWER`` exactly like a failed
+  conformance probe, and serving falls back to the reference rung.
+- **Output sentinels** — one vectorized NaN/Inf (and optional range)
+  reduction over every served batch, feeding ``numeric-sentinel`` events
+  and the circuit breaker's failure classification
+  (``FailureKind.NUMERIC``), so a rung that goes non-finite repeatedly
+  trips its breaker even though the batch was already served.
+- **Convergence tracing** — long solves emit per-epoch
+  ``solver-progress`` events (residual, delta-norm, iterations/s)
+  through :class:`ConvergenceTracker`, which also renders the STALLED
+  verdict ``top`` shows when the residual stops improving across K
+  epochs.
+
+``drift:<op>[:<scale>[:<nth>]]`` fault clauses (``core/faults.py``)
+perturb served outputs *below* the ``wrong:`` blow-up threshold, so the
+whole sample → budget → demote loop is deterministically testable on
+CPU.  Offline, the ``numerics`` CLI (``numerics_cli.py``) replays these
+events from a trace sink into the same report.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from . import metrics
+from .trace import record_event, trace_id
+
+#: 1-in-N shadow sampling rate (0/unset = disabled; 1 = every request)
+SHADOW_RATE_ENV = "CME213_SHADOW_RATE"
+#: rel-L2 drift tolerance for a shadow sample (default: 1e-5 — the
+#: shadow re-executes the sampled requests at a *different batch width*
+#: than they were served at, so reduction-order noise up to ~1e-7 at
+#: f32 is legitimate; anything structural — the smallest ``drift:``
+#: scale is 100× this — still clears the bar)
+SHADOW_REL_L2_ENV = "CME213_SHADOW_REL_L2"
+#: optional max-ulp drift tolerance (0/unset = rel-L2 only)
+SHADOW_MAX_ULPS_ENV = "CME213_SHADOW_MAX_ULPS"
+#: drift error budget: allowed fraction of shadow samples over tolerance
+DRIFT_BUDGET_ENV = "CME213_DRIFT_BUDGET"
+
+_DEFAULT_REL_L2 = 1e-5
+_DEFAULT_BUDGET = 0.1
+
+
+def shadow_rate() -> int:
+    """The configured 1-in-N sampling rate (0 = shadow sampling off)."""
+    raw = os.environ.get(SHADOW_RATE_ENV, "").strip()
+    if not raw:
+        return 0
+    try:
+        n = int(raw)
+    except ValueError:
+        return 0
+    return n if n >= 1 else 0
+
+
+def should_sample(rid: str, rate: int | None = None,
+                  trace: str | None = None) -> bool:
+    """Deterministic 1-in-``rate`` membership for request ``rid``.
+
+    The decision hashes ``(trace, rid)`` (``trace`` defaults to this
+    process's trace id) — no RNG state, no call counters — so every
+    process sharing a trace context (a gang under
+    ``CME213_TRACE_CONTEXT``, or a server keying by the request's own
+    ``trace_id``) samples exactly the same requests, and a re-run of the
+    same trace replays the same sample.
+    """
+    n = shadow_rate() if rate is None else rate
+    if n <= 0:
+        return False
+    if n == 1:
+        return True
+    key = f"{trace if trace is not None else trace_id()}|{rid}".encode()
+    h = int.from_bytes(hashlib.blake2b(key, digest_size=8).digest(), "big")
+    return h % n == 0
+
+
+def measure_drift(out, ref) -> tuple[float, int]:
+    """(rel_l2, max_ulps) between a served output and its shadow
+    reference.  This is a *measure*, not a verdict: shape/dtype mismatch
+    or a non-finite served output returns ``inf`` so the caller's
+    tolerance check always classifies it as over budget.  ``max_ulps``
+    is 0 for non-float outputs (bitwise workloads measure via rel-L2 on
+    the float64 cast)."""
+    out = np.asarray(out)
+    ref = np.asarray(ref)
+    if out.shape != ref.shape or out.dtype != ref.dtype:
+        return float("inf"), -1
+    if out.size == 0:
+        return 0.0, 0
+    if (np.issubdtype(out.dtype, np.floating)
+            and not np.isfinite(out).all()):
+        return float("inf"), -1
+    denom = float(np.linalg.norm(ref.astype(np.float64)))
+    rel_l2 = (float(np.linalg.norm((out.astype(np.float64)
+                                    - ref.astype(np.float64))))
+              / max(denom, float(np.finfo(np.float64).tiny)))
+    ulps = 0
+    if np.issubdtype(out.dtype, np.floating):
+        from .compare import ulp_distance
+
+        ulps = int(np.max(ulp_distance(ref, out)))
+    return rel_l2, ulps
+
+
+def _tolerances() -> tuple[float, int]:
+    try:
+        rel = float(os.environ.get(SHADOW_REL_L2_ENV, "") or _DEFAULT_REL_L2)
+    except ValueError:
+        rel = _DEFAULT_REL_L2
+    try:
+        ulps = int(os.environ.get(SHADOW_MAX_ULPS_ENV, "") or 0)
+    except ValueError:
+        ulps = 0
+    return rel, ulps
+
+
+# ---------------------------------------------------------------- budget
+
+
+@dataclass
+class _BudgetState:
+    """Per-(op, rung) drift budget — ``serve/slo.py``'s two-window AND
+    burn over the last N shadow samples instead of wall-clock windows
+    (sample-count windows make the burn independent of request rate, so
+    the same fault spec burns identically in CI and in a live fleet)."""
+
+    window: deque = field(default_factory=lambda: deque(maxlen=64))
+    burning: bool = False
+    samples: int = 0
+    over: int = 0
+    last_rel_l2: float = 0.0
+    last_max_ulps: int = 0
+
+
+class DriftBudget:
+    """Per-(op, rung) error budget over shadow-sample outcomes.
+
+    ``target`` is the allowed fraction of shadow samples over tolerance
+    (the error budget); burn = observed over-rate / target, evaluated
+    over a short (last ``short_n``) and long (last ``long_n``) sample
+    window.  Both burns must reach ``burn_threshold`` (with at least
+    ``min_samples`` observed) before the budget fires — and recovery
+    needs the short burn back under ``threshold * hysteresis``, exactly
+    the flap filter ``serve/slo.py`` uses for latency/shed/error SLOs.
+    """
+
+    def __init__(self, target: float | None = None, short_n: int = 8,
+                 long_n: int = 32, burn_threshold: float = 2.0,
+                 min_samples: int = 8, hysteresis: float = 0.5):
+        if target is None:
+            try:
+                target = float(os.environ.get(DRIFT_BUDGET_ENV, "")
+                               or _DEFAULT_BUDGET)
+            except ValueError:
+                target = _DEFAULT_BUDGET
+        if target <= 0:
+            raise ValueError(f"drift budget must be > 0, got {target}")
+        self.target = target
+        self.short_n = short_n
+        self.long_n = max(long_n, short_n)
+        self.burn_threshold = burn_threshold
+        self.min_samples = max(1, min_samples)
+        self.hysteresis = hysteresis
+        self._states: dict[tuple[str, str], _BudgetState] = {}
+
+    def _st(self, op: str, rung: str) -> _BudgetState:
+        return self._states.setdefault((op, rung),
+                                       _BudgetState(deque(maxlen=self.long_n)))
+
+    def observe(self, op: str, rung: str, over: bool,
+                rel_l2: float = 0.0, max_ulps: int = 0) -> bool:
+        """Fold one shadow-sample outcome in; returns the (possibly
+        transitioned) burning state.  Transitions record
+        ``drift-budget-burn`` / ``drift-budget-ok`` events."""
+        st = self._st(op, rung)
+        st.window.append(bool(over))
+        st.samples += 1
+        st.over += bool(over)
+        st.last_rel_l2 = rel_l2
+        st.last_max_ulps = max_ulps
+        long_win = list(st.window)
+        short_win = long_win[-self.short_n:]
+        burn_short = (sum(short_win) / len(short_win)) / self.target
+        burn_long = (sum(long_win) / len(long_win)) / self.target
+        if (not st.burning and len(long_win) >= self.min_samples
+                and burn_short >= self.burn_threshold
+                and burn_long >= self.burn_threshold):
+            st.burning = True
+            metrics.counter("numerics.budget.burns").inc()
+            record_event("drift-budget-burn", op=op, rung=rung,
+                         burn_short=round(burn_short, 3),
+                         burn_long=round(burn_long, 3),
+                         threshold=self.burn_threshold)
+        elif (st.burning
+              and burn_short <= self.burn_threshold * self.hysteresis):
+            st.burning = False
+            record_event("drift-budget-ok", op=op, rung=rung,
+                         burn_short=round(burn_short, 3))
+        return st.burning
+
+    def burning(self, op: str, rung: str) -> bool:
+        st = self._states.get((op, rung))
+        return bool(st and st.burning)
+
+    def state(self) -> dict:
+        """JSON-able per-(op, rung) budget state (reports, flight)."""
+        out = {}
+        for (op, rung), st in sorted(self._states.items()):
+            out[f"{op}|{rung}"] = {
+                "samples": st.samples, "over": st.over,
+                "last_rel_l2": st.last_rel_l2,
+                "last_max_ulps": st.last_max_ulps,
+                "burning": st.burning,
+                "demoted": (op, rung) in _DEMOTED,
+            }
+        return out
+
+
+#: module singletons: the serving path's budget + the demoted-rung set
+_BUDGET: DriftBudget | None = None
+_DEMOTED: set[tuple[str, str]] = set()
+
+
+def budget() -> DriftBudget:
+    """The process-wide drift budget (lazily built from the env)."""
+    global _BUDGET
+    if _BUDGET is None:
+        _BUDGET = DriftBudget()
+    return _BUDGET
+
+
+def demoted(op: str, rung: str) -> bool:
+    """True when (op, rung)'s drift budget burned and the rung must be
+    routed around.  Shaped as a ``with_fallback`` gate verdict: the
+    server passes ``lambda rung: not demoted(op, rung)`` so demotion
+    flows through the ladder's existing WRONG_ANSWER path.  Demotion is
+    sticky for the life of the process — a drifting kernel does not
+    silently rejoin the ladder; a restart (new incarnation) re-probes
+    clean."""
+    return (op, rung) in _DEMOTED
+
+
+def shadow_compare(op: str, rung: str, shape_class: str, outputs,
+                   references) -> dict:
+    """Compare one sampled batch's served ``outputs`` against its
+    re-executed reference ``references`` (parallel sequences, one entry
+    per request).  Records the drift histogram + ``numeric-drift``
+    event, feeds the (op, rung) budget, and flips the rung into the
+    demoted set when the budget burns.  Returns a summary dict
+    (``rel_l2``, ``max_ulps``, ``over_budget``, ``burning``,
+    ``demoted``).  Runs off the hot path by construction: callers invoke
+    it after the request latency was stamped."""
+    rel_tol, ulp_tol = _tolerances()
+    worst_rel, worst_ulps = 0.0, 0
+    for out, ref in zip(outputs, references):
+        rel_l2, ulps = measure_drift(out, ref)
+        worst_rel = max(worst_rel, rel_l2)
+        worst_ulps = max(worst_ulps, ulps) if ulps >= 0 else -1
+    over = worst_rel > rel_tol or (ulp_tol > 0 and worst_ulps > ulp_tol)
+    metrics.counter("numerics.shadow.samples").inc()
+    hist_rel = worst_rel if np.isfinite(worst_rel) else 1.0
+    metrics.histogram(f"numerics.drift.{op}.{rung}").observe(hist_rel)
+    if over:
+        metrics.counter("numerics.shadow.over_budget").inc()
+    record_event("numeric-drift", op=op, rung=rung, shape_class=shape_class,
+                 rel_l2=(round(worst_rel, 9) if np.isfinite(worst_rel)
+                         else "inf"),
+                 max_ulps=worst_ulps, over_budget=over)
+    burning = budget().observe(op, rung, over, rel_l2=hist_rel,
+                               max_ulps=worst_ulps)
+    if burning and (op, rung) not in _DEMOTED:
+        _DEMOTED.add((op, rung))
+        metrics.gauge("numerics.demoted").set(len(_DEMOTED))
+    return {"rel_l2": worst_rel, "max_ulps": worst_ulps,
+            "over_budget": over, "burning": burning,
+            "demoted": demoted(op, rung)}
+
+
+# -------------------------------------------------------------- sentinels
+
+
+def sentinel(op: str, rung: str, outputs, lo: float | None = None,
+             hi: float | None = None, breaker=None) -> int:
+    """Cheap output sentinel over one served batch: a single vectorized
+    non-finite reduction per output array (plus an optional [lo, hi]
+    range check), no reference execution.  Returns the bad-element
+    count; a non-zero count records a ``numeric-sentinel`` event and
+    feeds ``breaker.record_failure(op, rung, FailureKind.NUMERIC)`` so a
+    rung that keeps emitting NaNs trips its circuit even though each
+    batch was already served."""
+    bad = 0
+    size = 0
+    kind = "non-finite"
+    for out in outputs:
+        arr = np.asarray(out)
+        size += arr.size
+        if np.issubdtype(arr.dtype, np.floating):
+            finite = np.isfinite(arr)
+            bad += int(arr.size - np.count_nonzero(finite))
+            if lo is not None or hi is not None:
+                in_range = finite.copy()
+                if lo is not None:
+                    in_range &= arr >= lo
+                if hi is not None:
+                    in_range &= arr <= hi
+                out_of_range = int(np.count_nonzero(finite)
+                                   - np.count_nonzero(in_range))
+                if out_of_range:
+                    kind = "out-of-range"
+                    bad += out_of_range
+    if bad:
+        metrics.counter("numerics.sentinel.tripped").inc()
+        record_event("numeric-sentinel", op=op, rung=rung, kind=kind,
+                     count=bad, size=size)
+        if breaker is not None:
+            from .resilience import FailureKind
+
+            breaker.record_failure(op, rung, FailureKind.NUMERIC)
+    return bad
+
+
+# ------------------------------------------------------------ convergence
+
+
+class ConvergenceTracker:
+    """Per-solve convergence trace: one ``solver-progress`` event per
+    epoch/chunk (residual, delta-norm, iterations/s) plus the STALLED
+    verdict — the residual failing to improve by ``min_improve``
+    (relative) for ``stall_epochs`` consecutive steps.  The checkpointed
+    and supervised solve loops feed it; ``trace summary`` and ``top``
+    read the events back."""
+
+    def __init__(self, op: str, stall_epochs: int = 5,
+                 min_improve: float = 1e-3):
+        self.op = op
+        self.stall_epochs = max(1, stall_epochs)
+        self.min_improve = min_improve
+        self.best: float | None = None
+        self.since_improve = 0
+        self.steps = 0
+
+    def step(self, step: int, residual: float, delta_norm: float,
+             iters_per_s: float) -> None:
+        """Record one epoch's progress (events + gauges) and advance the
+        stall detector."""
+        self.steps += 1
+        residual = float(residual)
+        record_event("solver-progress", op=self.op, step=int(step),
+                     residual=round(residual, 9),
+                     delta_norm=round(float(delta_norm), 9),
+                     iters_per_s=round(float(iters_per_s), 3))
+        metrics.counter("numerics.progress").inc()
+        metrics.gauge(f"numerics.residual.{self.op}").set(round(residual, 9))
+        if (self.best is None
+                or residual < self.best * (1.0 - self.min_improve)):
+            self.best = residual
+            self.since_improve = 0
+        else:
+            self.since_improve += 1
+
+    @property
+    def stalled(self) -> bool:
+        return self.since_improve >= self.stall_epochs
+
+
+def state_snapshot(state):
+    """Host copy of ``state``'s first float leaf, or None.  Take it
+    BEFORE running a step whose jitted program donates its input buffers
+    (e.g. heat2d's ``donate_argnums``) — the device array is deleted by
+    the time :func:`progress_from_states` would read it; the snapshot is
+    the ``old_state`` that survives."""
+    try:
+        arr = _first_float_leaf(state)
+        return None if arr is None else np.array(arr)
+    except Exception:  # noqa: BLE001 — same contract as below
+        return None
+
+
+def progress_from_states(tracker: ConvergenceTracker, step: int,
+                         old_state, new_state, iters: int,
+                         elapsed_s: float) -> None:
+    """Feed a tracker from two consecutive solver states: delta-norm is
+    ``||new - old||`` over the first float leaf, residual the relative
+    change ``delta / max(||new||, tiny)`` — the generic convergence
+    signal every fixed-point solve exposes without knowing its PDE."""
+    try:
+        old_arr = _first_float_leaf(old_state)
+        new_arr = _first_float_leaf(new_state)
+    except Exception:  # noqa: BLE001 — progress tracing must never take
+        # down the solve it observes (e.g. a non-addressable shard)
+        return
+    if old_arr is None or new_arr is None or old_arr.shape != new_arr.shape:
+        return
+    delta = float(np.linalg.norm((new_arr.astype(np.float64)
+                                  - old_arr.astype(np.float64))))
+    denom = max(float(np.linalg.norm(new_arr.astype(np.float64))),
+                float(np.finfo(np.float64).tiny))
+    tracker.step(step, residual=delta / denom, delta_norm=delta,
+                 iters_per_s=(iters / elapsed_s if elapsed_s > 0 else 0.0))
+
+
+def _first_float_leaf(state):
+    try:
+        from jax import tree_util
+        leaves = tree_util.tree_flatten(state)[0]
+    except ImportError:  # pragma: no cover - jax always present here
+        leaves = [state]
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating):
+            return arr
+    return None
+
+
+# ------------------------------------------------------------- snapshots
+
+
+def last_drift() -> dict:
+    """Best-effort numeric-health snapshot for the flight recorder and
+    reports: per-(op, rung) budget state + the demoted set.  ``{}`` when
+    nothing was ever sampled."""
+    if _BUDGET is None and not _DEMOTED:
+        return {}
+    snap = {"budget": budget().state(),
+            "demoted": sorted(f"{op}|{rung}" for op, rung in _DEMOTED)}
+    return snap
+
+
+def reset() -> None:
+    """Forget budgets, demotions, and cached config (tests)."""
+    global _BUDGET
+    _BUDGET = None
+    _DEMOTED.clear()

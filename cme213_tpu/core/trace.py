@@ -149,6 +149,16 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     # SLO burn-rate monitor (serve/slo.py)
     "slo-burn": ("objective", "burn_short", "burn_long", "threshold"),
     "slo-ok": ("objective", "burn_short"),
+    # numeric-health observatory (core/numerics.py): shadow conformance
+    # sampling, output sentinels, convergence tracing
+    "numeric-drift": ("op", "rung", "shape_class", "rel_l2", "max_ulps",
+                      "over_budget"),
+    "numeric-sentinel": ("op", "rung", "kind", "count", "size"),
+    "solver-progress": ("op", "step", "residual", "delta_norm",
+                        "iters_per_s"),
+    "drift-budget-burn": ("op", "rung", "burn_short", "burn_long",
+                          "threshold"),
+    "drift-budget-ok": ("op", "rung", "burn_short"),
     # flight recorder (core/flight.py)
     "flight-dump": ("reason", "path", "events"),
     # telemetry itself

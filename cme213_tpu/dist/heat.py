@@ -400,8 +400,12 @@ def run_distributed_heat_supervised(params: SimParams, mesh: Mesh,
     Returns the final full halo grid (gy, gx) as numpy, like
     ``run_distributed_heat``.
     """
+    import time
+
     from ..core import metrics
     from ..core.faults import maybe_kill_rank, maybe_oom
+    from ..core.numerics import (ConvergenceTracker, progress_from_states,
+                                 state_snapshot)
     from ..core.resilience import FailureKind, classify_failure
     from ..core.trace import record_event
     from .ckpt import check_meta, commit_epoch, load_latest_commit
@@ -440,11 +444,19 @@ def run_distributed_heat_supervised(params: SimParams, mesh: Mesh,
     if heartbeat is not None:
         heartbeat.beat(start)
     it = start
+    # per-epoch convergence trace: the supervised solve's residual,
+    # delta-norm, and iterations/s ride solver-progress events so a
+    # stalled gang is visible in `top` before the supervisor's timeout
+    tracker = ConvergenceTracker("heat2d")
     while it < iters:
         # deterministic kill window: `step` counts committed epochs, so
         # rankkill:<rank>:<e> always dies holding exactly e commits
         maybe_kill_rank(step=epoch)
         k = min(ckpt_every, iters - it)
+        # host snapshot before the epoch: the sharded step may donate
+        # its input buffers, and the residual needs the pre-step state
+        prev = state_snapshot(u)
+        t0 = time.perf_counter()
         try:
             maybe_oom("heat_chunk")
             u_new = _run(u, params, mesh, k, overlap)
@@ -473,6 +485,8 @@ def run_distributed_heat_supervised(params: SimParams, mesh: Mesh,
                     np.array(interior(full0, b)), params, y_size, x_size)
             u = jax.device_put(jnp.asarray(u_host, dtype), sharding)
             continue
+        progress_from_states(tracker, it + k, prev, u_new, k,
+                             time.perf_counter() - t0)
         u = u_new
         it += k
         epoch += 1

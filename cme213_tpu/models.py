@@ -107,6 +107,12 @@ def _top(argv: list[str]) -> int:
     return top_cli.main(argv)
 
 
+def _numerics(argv: list[str]) -> int:
+    from . import numerics_cli
+
+    return numerics_cli.main(argv)
+
+
 WORKLOADS: dict[str, Workload] = {
     w.name: w
     for w in (
@@ -162,6 +168,15 @@ WORKLOADS: dict[str, Workload] = {
                  "collector: per-rank state/step/heartbeat-age rows, "
                  "fleet gauges, recent events; deterministic --once/"
                  "--json for CI", _top),
+        # not a reference workload: the numeric-health report over trace
+        # sinks — shadow-sample drift, budget burns/demotions, sentinel
+        # trips, solver convergence; exit codes are the CI gate
+        Workload("numerics", "telemetry", "report: numeric-health rollup "
+                 "over trace sinks (shadow drift samples, error-budget "
+                 "burns and rung demotions, output-sentinel trips, "
+                 "solver convergence/stall); --json for CI, "
+                 "--max-over-budget/--forbid-stall gate with exit 1",
+                 _numerics),
     )
 }
 

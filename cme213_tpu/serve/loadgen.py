@@ -33,7 +33,7 @@ import sys
 
 import numpy as np
 
-from ..core import flight, metrics, trace
+from ..core import flight, metrics, numerics, trace
 from ..core.metrics import _nearest_rank
 from ..core.resilience import Clock
 from . import slo as slo_mod
@@ -279,6 +279,17 @@ def slo_report(run: dict, before: dict, after: dict, slo=None) -> dict:
             "burn_events": len(trace.events("slo-burn")),
             "ok_events": len(trace.events("slo-ok")),
         },
+        # numeric health (core/numerics.py): shadow-sample drift counts
+        # from the metrics delta + the drift budget's live snapshot
+        "numerics": {
+            "shadow_samples": counters.get("numerics.shadow.samples", 0),
+            "shadow_over_budget":
+                counters.get("numerics.shadow.over_budget", 0),
+            "shadow_errors": counters.get("numerics.shadow.errors", 0),
+            "sentinel_trips": counters.get("numerics.sentinel.tripped", 0),
+            "budget_burns": counters.get("numerics.budget.burns", 0),
+            "demoted": (numerics.last_drift() or {}).get("demoted", []),
+        },
     }
 
 
@@ -346,6 +357,16 @@ def format_report(report: dict) -> str:
                 f"  {name} ({st['kind']} target {st['target']}): "
                 f"burn short {st['burn_short']} long {st['burn_long']}"
                 f"{'  BURNING' if st['burning'] else ''}")
+    num = report.get("numerics") or {}
+    if num.get("shadow_samples") or num.get("sentinel_trips") \
+            or num.get("demoted"):
+        lines.append(
+            f"numerics: {num['shadow_samples']} shadow sample(s), "
+            f"{num['shadow_over_budget']} over budget, "
+            f"{num['budget_burns']} budget burn(s), "
+            f"{num['sentinel_trips']} sentinel trip(s)")
+        for key in num.get("demoted") or []:
+            lines.append(f"  DEMOTED {key}")
     if "baseline" in report:
         b = report["baseline"]
         lines.append(f"baseline (max_batch=1): {b['throughput_rps']} req/s "
@@ -383,6 +404,10 @@ def main(argv: list[str]) -> int:
                     help="shed-rate budget objective (fraction)")
     ap.add_argument("--slo-error-rate", type=float, default=None,
                     help="error-rate budget objective (fraction)")
+    ap.add_argument("--slo-drift-rate", type=float, default=None,
+                    help="numeric-drift budget objective: fraction of "
+                    "shadow-sampled requests allowed over the drift "
+                    "tolerance (needs CME213_SHADOW_RATE)")
     ap.add_argument("--slo-short-s", type=float, default=5.0)
     ap.add_argument("--slo-long-s", type=float, default=60.0)
     ap.add_argument("--slo-burn-threshold", type=float, default=2.0)
@@ -414,7 +439,8 @@ def main(argv: list[str]) -> int:
         clock = Clock()
         last_slo = slo_mod.from_flags(
             clock, p99_ms=args.slo_p99_ms, shed_rate=args.slo_shed_rate,
-            error_rate=args.slo_error_rate, short_s=args.slo_short_s,
+            error_rate=args.slo_error_rate,
+            drift_rate=args.slo_drift_rate, short_s=args.slo_short_s,
             long_s=args.slo_long_s, burn_threshold=args.slo_burn_threshold,
             min_samples=args.slo_min_samples)
         return Server(capacity=args.capacity, max_batch=max_batch,

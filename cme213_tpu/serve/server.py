@@ -53,9 +53,9 @@ from __future__ import annotations
 import itertools
 from contextlib import nullcontext
 
-from ..core import admission, metrics
+from ..core import admission, metrics, numerics
 from ..core.errors import FrameworkError
-from ..core.faults import maybe_slow
+from ..core.faults import maybe_drift, maybe_slow
 from ..core.resilience import CircuitBreaker, Clock, with_fallback
 from ..core.trace import (current_span_id, record_event, span,
                           trace_id as current_trace_id)
@@ -309,9 +309,14 @@ class Server:
         op = adapter.op
         payloads = [r.payload for r in batch]
         rungs = adapter.rungs(self.degraded)
+        # ``drift:serve.<op>.<rung>`` clauses perturb the served outputs
+        # *inside* the ladder, so the shadow sampler's reference
+        # re-execution (a direct run_batch below) stays clean — exactly
+        # the silent-divergence topology shadow sampling exists to catch
         ladder = [(rung,
-                   (lambda rg: lambda: adapter.run_batch(
-                       payloads, rg, coarse=coarse))(rung))
+                   (lambda rg: lambda: maybe_drift(
+                       f"serve.{op}.{rg}", adapter.run_batch(
+                           payloads, rg, coarse=coarse)))(rung))
                   for rung in rungs]
         ctx = (span("degraded-mode", op=op,
                     reason=self._degrade_reason or "pressure")
@@ -327,8 +332,13 @@ class Server:
                            size=len(batch)):
                 batch_span = current_span_id()
                 maybe_slow(f"serve.{op}", sleep=self.clock.sleep)
-                res = with_fallback(f"serve.{op}", ladder,
-                                    breaker=self.breaker)
+                # the gate is the drift budget's demotion hook: a rung
+                # whose shadow-sample budget burned is routed around with
+                # FailureKind.WRONG_ANSWER, exactly like a failed
+                # conformance probe (core/numerics.py)
+                res = with_fallback(
+                    f"serve.{op}", ladder, breaker=self.breaker,
+                    gate=lambda rg: not numerics.demoted(f"serve.{op}", rg))
         except FrameworkError as e:
             end = self.clock.now()
             metrics.counter("serve.failed").inc(len(batch))
@@ -356,6 +366,13 @@ class Server:
         metrics.histogram("serve.batch.size").observe(len(batch))
         record_event("batch-executed", op=op, shape_class=key,
                      size=len(batch), occupancy=round(occupancy, 4))
+        # output sentinel: one vectorized non-finite reduction over the
+        # served batch; a trip is recorded and fed to the breaker as
+        # FailureKind.NUMERIC but the batch still serves (observability,
+        # not a result change — the breaker decides about the *next* one)
+        lo, hi = getattr(adapter, "sentinel_range", (None, None))
+        numerics.sentinel(f"serve.{op}", res.rung, res.value, lo=lo, hi=hi,
+                          breaker=self.breaker)
         out = []
         for r, value in zip(batch, res.value):
             r.completed_s = end
@@ -380,8 +397,47 @@ class Server:
                 trace_id=r.trace_id)
             self._observe_slo(res_ok)
             out.append(res_ok)
+        # shadow conformance sampling runs LAST: every latency above was
+        # already stamped on the clock, so the reference re-execution is
+        # off the measured hot path by construction
+        self._shadow(adapter, key, batch, payloads, res, coarse)
         metrics.write_exposition()   # no-op unless CME213_METRICS_FILE set
         return out
+
+    def _shadow(self, adapter, key: str, batch, payloads, res,
+                coarse) -> None:
+        """Re-execute a deterministic 1-in-N sample of this batch's
+        requests on the reference rung and fold the measured drift into
+        the numeric-health observatory (``core/numerics.py``).  Never
+        raises into the serving path; skipped entirely when the serving
+        rung *is* the reference (drift against itself is zero)."""
+        rate = numerics.shadow_rate()
+        if not rate:
+            return
+        op = adapter.op
+        ref_rung = adapter.rungs(False)[-1]
+        if res.rung == ref_rung:
+            return
+        picked = [i for i, r in enumerate(batch)
+                  if numerics.should_sample(str(r.rid), rate=rate,
+                                            trace=r.trace_id)]
+        if not picked:
+            return
+        try:
+            with span("serve.shadow", op=op, shape_class=key,
+                      size=len(picked)):
+                refs = adapter.run_batch([payloads[i] for i in picked],
+                                         ref_rung, coarse=coarse)
+            summary = numerics.shadow_compare(
+                f"serve.{op}", res.rung, key,
+                [res.value[i] for i in picked], refs)
+        except Exception:  # noqa: BLE001 — the shadow path must never
+            # take down serving; a crashed reference re-execution only
+            # costs this sample
+            metrics.counter("numerics.shadow.errors").inc()
+            return
+        if self.slo is not None:
+            self.slo.observe(drift=summary["over_budget"])
 
     def _update_degraded(self) -> None:
         if self.slo is not None:

@@ -18,6 +18,11 @@ Burn semantics:
   on-budget, 2.0 means consuming budget twice as fast as allowed.
 - ``shed_rate`` / ``error_rate``: ``target`` *is* the budget fraction;
   burn = observed rate / target.
+- ``drift_rate``: ``target`` is the allowed fraction of *shadow
+  conformance samples* (``core/numerics.py``) over their drift
+  tolerance; burn = observed over-tolerance rate / target, evaluated
+  over the shadow samples only.  This is the fleet-level view of the
+  same signal the per-(op, rung) drift budget demotes rungs on.
 
 Transitions are evented (``slo-burn`` on entry, ``slo-ok`` on recovery)
 and the worst short-window burn is exported as the ``serve.slo.burn``
@@ -43,7 +48,7 @@ from ..core.resilience import Clock
 from ..core.trace import record_event
 
 #: objective kinds (see module docstring for burn semantics)
-KINDS = ("p99_latency_ms", "shed_rate", "error_rate")
+KINDS = ("p99_latency_ms", "shed_rate", "error_rate", "drift_rate")
 
 
 @dataclass(frozen=True)
@@ -83,7 +88,9 @@ class SLOMonitor:
         self.burn_threshold = burn_threshold
         self.min_samples = max(1, min_samples)
         self.hysteresis = hysteresis
-        #: (t, latency_ms | None, shed, failed) per finished request
+        #: (t, latency_ms | None, shed, failed, drift | None) per
+        #: finished request; ``drift`` is None unless the request was a
+        #: shadow conformance sample (then True = over tolerance)
         self._samples: deque = deque()
         self._burning: dict[str, bool] = {o.name: False
                                           for o in self.objectives}
@@ -92,11 +99,15 @@ class SLOMonitor:
     # ------------------------------------------------------------ intake
 
     def observe(self, latency_ms: float | None = None,
-                shed: bool = False, failed: bool = False) -> None:
+                shed: bool = False, failed: bool = False,
+                drift: bool | None = None) -> None:
         """Record one finished request (call with the served latency, or
-        ``shed=True`` / ``failed=True``)."""
+        ``shed=True`` / ``failed=True``; ``drift`` carries a shadow
+        conformance sample's over-tolerance verdict when the request was
+        sampled)."""
         self._samples.append(
-            (self.clock.now(), latency_ms, bool(shed), bool(failed)))
+            (self.clock.now(), latency_ms, bool(shed), bool(failed),
+             drift if drift is None else bool(drift)))
 
     def observe_result(self, result) -> None:
         """``observe()`` from a :class:`~.request.SolveResult`."""
@@ -116,6 +127,12 @@ class SLOMonitor:
                 return None
             over = sum(1 for v in lat if v > objective.target) / len(lat)
             return over / objective.budget
+        if objective.kind == "drift_rate":
+            shadow = [s[4] for s in window if s[4] is not None]
+            if not shadow:
+                return None
+            return (sum(1 for v in shadow if v) / len(shadow)
+                    / objective.target)
         if not window:
             return None
         if objective.kind == "shed_rate":
@@ -185,7 +202,8 @@ class SLOMonitor:
 
 def from_flags(clock: Clock | None = None, *,
                p99_ms: float | None = None, shed_rate: float | None = None,
-               error_rate: float | None = None, short_s: float = 5.0,
+               error_rate: float | None = None,
+               drift_rate: float | None = None, short_s: float = 5.0,
                long_s: float = 60.0, burn_threshold: float = 2.0,
                min_samples: int = 10) -> SLOMonitor | None:
     """Build a monitor from CLI-flag values; None when no objective was
@@ -197,6 +215,8 @@ def from_flags(clock: Clock | None = None, *,
         objectives.append(Objective("shed-rate", "shed_rate", shed_rate))
     if error_rate is not None:
         objectives.append(Objective("error-rate", "error_rate", error_rate))
+    if drift_rate is not None:
+        objectives.append(Objective("drift-rate", "drift_rate", drift_rate))
     if not objectives:
         return None
     return SLOMonitor(objectives, clock=clock, short_window_s=short_s,

@@ -80,6 +80,26 @@ def render_top(state: dict, out=None) -> None:
               f" slo_burns={fl.get('slo_burns', 0)}"
               f" breaker_opens={fl.get('breaker_opens', 0)}"
               f" requests={fl.get('requests', 0)}\n")
+    out.write("numerics: "
+              f"drift={fl.get('drift_samples', 0)}"
+              f"/{fl.get('drift_over_budget', 0)}over "
+              f"demotions={fl.get('drift_demotions', 0)} "
+              f"sentinels={fl.get('sentinel_trips', 0)} "
+              f"conformance_failures={fl.get('conformance_failures', 0)} "
+              f"attribution_mismatches="
+              f"{fl.get('attribution_mismatches', 0)}\n")
+
+    solvers = state.get("solvers") or {}
+    if solvers:
+        out.write("solvers:\n")
+        for op, row in solvers.items():
+            verdict = "STALLED" if row.get("stalled") else "converging"
+            res = row.get("residual")
+            ips = row.get("iters_per_s")
+            out.write(f"  {op:<14} step={row.get('step')} "
+                      f"residual={res if res is not None else '-'} "
+                      f"iters/s={ips if ips is not None else '-'} "
+                      f"{verdict}\n")
 
     spans = sorted(state["spans"].items(),
                    key=lambda kv: kv[1]["total_ms"], reverse=True)[:5]

@@ -551,6 +551,96 @@ def summarize(events: list[dict], out=None) -> dict:
         for e in oks:
             w(f"  ok {e.get('objective')}: short {e.get('burn_short')}\n")
 
+    # numeric health (core/numerics.py): shadow conformance drift,
+    # budget demotions, and output sentinels — the continuous form of
+    # the conformance section's one-shot probes above
+    numeric = None
+    drifts = [e for e in events if e["event"] == "numeric-drift"]
+    d_burns = [e for e in events if e["event"] == "drift-budget-burn"]
+    d_oks = [e for e in events if e["event"] == "drift-budget-ok"]
+    sentinels = [e for e in events if e["event"] == "numeric-sentinel"]
+    if drifts or d_burns or sentinels:
+        per_rung: dict = {}
+        for e in drifts:
+            key = f"{e.get('op')}.{e.get('rung')}"
+            row = per_rung.setdefault(
+                key, {"samples": 0, "over_budget": 0, "worst_rel_l2": 0.0,
+                      "worst_ulps": 0})
+            row["samples"] += 1
+            row["over_budget"] += bool(e.get("over_budget"))
+            rel = e.get("rel_l2")
+            if isinstance(rel, (int, float)):
+                row["worst_rel_l2"] = max(row["worst_rel_l2"], rel)
+            else:  # "inf" marker: shape/dtype mismatch or non-finite
+                row["worst_rel_l2"] = "inf"
+            ulps = e.get("max_ulps")
+            if isinstance(ulps, int) and isinstance(row["worst_ulps"], int):
+                row["worst_ulps"] = (max(row["worst_ulps"], ulps)
+                                     if ulps >= 0 else ulps)
+        numeric = {
+            "drift": per_rung,
+            "samples": len(drifts),
+            "over_budget": sum(1 for e in drifts if e.get("over_budget")),
+            "demotions": [f"{e.get('op')}.{e.get('rung')}"
+                          for e in d_burns],
+            "recoveries": len(d_oks),
+            "sentinels": {
+                "trips": len(sentinels),
+                "bad_elems": sum(e.get("count") or 0 for e in sentinels)},
+        }
+        w(f"numeric health: {numeric['samples']} shadow sample(s), "
+          f"{numeric['over_budget']} over budget, "
+          f"{len(d_burns)} budget burn(s), "
+          f"{len(sentinels)} sentinel trip(s)\n")
+        for key, row in sorted(per_rung.items()):
+            w(f"  {key}: {row['samples']} sample(s), "
+              f"{row['over_budget']} over, "
+              f"worst rel_l2 {row['worst_rel_l2']}"
+              + (f", worst ulps {row['worst_ulps']}"
+                 if row["worst_ulps"] else "") + "\n")
+        for e in d_burns:
+            w(f"  DEMOTED {e.get('op')}.{e.get('rung')}: burn short "
+              f"{e.get('burn_short')} long {e.get('burn_long')} "
+              f">= {e.get('threshold')}\n")
+        for e in sentinels:
+            w(f"  sentinel {e.get('op')}.{e.get('rung')}: "
+              f"{e.get('kind')} x{e.get('count')} "
+              f"(of {e.get('size')} elems)\n")
+
+    # convergence (core/numerics.ConvergenceTracker feeders): per-op
+    # solver-progress rollup with the same stall policy `top` renders
+    convergence = None
+    progress = [e for e in events if e["event"] == "solver-progress"]
+    if progress:
+        convergence = {}
+        for e in progress:
+            op = str(e.get("op") or "solver")
+            row = convergence.setdefault(
+                op, {"epochs": 0, "first_residual": e.get("residual"),
+                     "last_residual": None, "last_step": None,
+                     "iters_per_s": None, "_best": None, "_since": 0,
+                     "stalled": False})
+            row["epochs"] += 1
+            res = e.get("residual")
+            row["last_residual"] = res
+            row["last_step"] = e.get("step")
+            row["iters_per_s"] = e.get("iters_per_s")
+            if isinstance(res, (int, float)):
+                if row["_best"] is None or res < row["_best"] * (1 - 1e-3):
+                    row["_best"], row["_since"] = res, 0
+                else:
+                    row["_since"] += 1
+                row["stalled"] = row["_since"] >= 5
+        for op, row in convergence.items():
+            row.pop("_best"), row.pop("_since")
+        w(f"convergence: {len(convergence)} solver(s), "
+          f"{len(progress)} progress event(s)\n")
+        for op, row in sorted(convergence.items()):
+            w(f"  {op}: {row['epochs']} epoch(s), residual "
+              f"{row['first_residual']} -> {row['last_residual']} "
+              f"@step {row['last_step']}, {row['iters_per_s']} iters/s "
+              f"{'STALLED' if row['stalled'] else ''}".rstrip() + "\n")
+
     # autotuning (core/tune.py): search activity + the tuned-vs-default
     # split at dispatch — the "is the cache actually consulted" signal
     tuning = None
@@ -628,6 +718,8 @@ def summarize(events: list[dict], out=None) -> dict:
             "phases": phases,
             "tenants": tenants,
             "slo": slo,
+            "numerics": numeric,
+            "convergence": convergence,
             "tuning": tuning,
             "counts": dict(counts)}
 
@@ -851,6 +943,17 @@ def render_flight(doc: dict, out=None) -> None:
         if frame:
             tail = (f" ({frame['error']})" if frame.get("error") else "")
             w(f"{label}: {frame.get('op')} @ {frame.get('stage')}{tail}\n")
+    numeric = doc.get("numerics") or {}
+    if numeric.get("budget") or numeric.get("demoted"):
+        demoted = numeric.get("demoted") or []
+        w(f"last numeric drift: {len(numeric.get('budget') or {})} "
+          f"budgeted rung(s), {len(demoted)} demoted"
+          + (f" ({', '.join(demoted)})" if demoted else "") + "\n")
+        for key, st in sorted((numeric.get("budget") or {}).items()):
+            w(f"  {key}: {st.get('samples')} sample(s), "
+              f"{st.get('over')} over, last rel_l2 "
+              f"{st.get('last_rel_l2')}"
+              + (" BURNING" if st.get("burning") else "") + "\n")
     events = doc.get("events") or []
     w(f"last {len(events)} event(s) before death:\n")
     render_timeline(events, out=out)

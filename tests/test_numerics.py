@@ -1,0 +1,401 @@
+"""Numeric-health observatory (``core/numerics.py``): shadow conformance
+sampling, drift error budgets, output sentinels, convergence tracing,
+and the ``numerics`` CLI gate.
+
+The anchor test is the full loop the subsystem exists for: an injected
+``drift:`` fault perturbs a serving rung's outputs *below* the ``wrong:``
+blow-up threshold, the shadow sampler catches it against the reference
+rung, the per-(op, rung) error budget burns, the ladder gate demotes the
+rung — and served results are bitwise-identical to the reference again.
+All CPU-deterministic: count-window budgets, seeded sampling, fault
+clauses instead of real numeric decay.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cme213_tpu.core import faults, metrics, trace
+from cme213_tpu.core import numerics
+from cme213_tpu.core.resilience import FailureKind, VirtualClock
+from cme213_tpu.serve import Server
+from cme213_tpu.serve import slo as slo_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    trace.clear_events()
+    metrics.reset()
+    numerics.reset()
+    yield
+    faults.reset()
+    numerics.reset()
+    metrics.reset()
+
+
+class FloatEchoAdapter:
+    """Two-rung echo over float payloads: ``fast`` and ``safe`` both
+    return the payload array unchanged, so the reference rung (``safe``)
+    is bitwise-correct by construction and any drift on ``fast`` comes
+    from an injected ``drift:serve.echo.fast`` clause."""
+
+    op = "echo"
+
+    def __init__(self):
+        self.calls: list[tuple[str, int]] = []
+
+    def shape_class(self, payload, coarse: bool = False) -> str:
+        return "any" if coarse else payload[0]
+
+    def rungs(self, degraded: bool = False):
+        return ("safe",) if degraded else ("fast", "safe")
+
+    def run_batch(self, payloads, rung: str, coarse: bool = False):
+        self.calls.append((rung, len(payloads)))
+        return [np.array(p[1], dtype=np.float32) for p in payloads]
+
+    def preflight_builder(self, payloads, rung, coarse=False):
+        return None
+
+
+def echo_server(**kw):
+    adapter = FloatEchoAdapter()
+    kw.setdefault("clock", VirtualClock())
+    return Server(adapters={"echo": adapter}, **kw), adapter
+
+
+# ------------------------------------------------- the full shadow loop
+
+def test_drift_fault_caught_budget_burns_rung_demoted(monkeypatch):
+    monkeypatch.setenv(numerics.SHADOW_RATE_ENV, "1")
+    server, adapter = echo_server(max_batch=4)
+    payloads = [np.full(8, float(i + 1), dtype=np.float32)
+                for i in range(12)]
+    results = []
+    with faults.injected("drift:serve.echo.fast"):
+        for i, payload in enumerate(payloads):
+            server.submit("echo", ("k", payload))
+            results.extend(server.step())
+
+    assert [r.status for r in results] == ["ok"] * 12
+
+    # phase 1: the drifting rung serves, every shadow sample is over
+    drift_events = trace.events("numeric-drift")
+    assert len(drift_events) >= numerics.budget().min_samples
+    assert all(e["op"] == "serve.echo" and e["rung"] == "fast"
+               and e["over_budget"] for e in drift_events)
+    # the perturbation is small (1 + 1e-3): below wrong:'s blow-up, but
+    # far above the shadow tolerance
+    assert all(0 < e["rel_l2"] < 1e-2 for e in drift_events)
+
+    # phase 2: the budget burns and the rung is demoted, sticky
+    burns = trace.events("drift-budget-burn")
+    assert len(burns) == 1
+    assert burns[0]["op"] == "serve.echo" and burns[0]["rung"] == "fast"
+    assert numerics.demoted("serve.echo", "fast")
+    snap = numerics.last_drift()
+    assert snap["demoted"] == ["serve.echo|fast"]
+    assert snap["budget"]["serve.echo|fast"]["burning"]
+
+    # phase 3: post-demotion requests serve on the reference rung and
+    # match the submitted payload bitwise (the drift clause still
+    # targets fast — it simply no longer runs)
+    demoted_at = next(i for i, r in enumerate(results) if r.rung == "safe")
+    assert demoted_at <= numerics.budget().min_samples
+    for i, r in enumerate(results[demoted_at:], start=demoted_at):
+        assert r.rung == "safe"
+        np.testing.assert_array_equal(np.asarray(r.value), payloads[i])
+    # pre-demotion results really were drifted — the fault was live
+    assert not np.array_equal(np.asarray(results[0].value), payloads[0])
+
+    # the reference rung is never shadow-sampled against itself
+    assert all(e["rung"] == "fast" for e in trace.events("numeric-drift"))
+
+
+def test_clean_serving_has_zero_drift_over_budget(monkeypatch):
+    monkeypatch.setenv(numerics.SHADOW_RATE_ENV, "1")
+    server, adapter = echo_server(max_batch=4)
+    for i in range(6):
+        server.submit("echo", ("k", np.full(4, float(i + 1), np.float32)))
+        server.step()
+    drift_events = trace.events("numeric-drift")
+    assert len(drift_events) == 6
+    assert not any(e["over_budget"] for e in drift_events)
+    assert not trace.events("drift-budget-burn")
+    assert numerics.last_drift()["demoted"] == []
+
+
+def test_shadow_off_by_default():
+    server, adapter = echo_server(max_batch=4)
+    server.submit("echo", ("k", np.ones(4, np.float32)))
+    server.step()
+    assert not trace.events("numeric-drift")
+    # only the serving rung ran — no reference re-execution happened
+    assert [c[0] for c in adapter.calls] == ["fast"]
+
+
+# -------------------------------------------------- seeded sampling
+
+def test_should_sample_deterministic_across_processes():
+    rids = [str(i) for i in range(400)]
+    rank0 = {r for r in rids if numerics.should_sample(r, rate=4, trace="T")}
+    rank1 = {r for r in rids if numerics.should_sample(r, rate=4, trace="T")}
+    assert rank0 == rank1                  # gangs sample the same requests
+    assert 0 < len(rank0) < len(rids)      # it is a sample, not all/none
+    other = {r for r in rids if numerics.should_sample(r, rate=4, trace="U")}
+    assert other != rank0                  # keyed by trace context
+    assert all(numerics.should_sample(r, rate=1, trace="T") for r in rids)
+    assert not any(numerics.should_sample(r, rate=0, trace="T") for r in rids)
+
+
+def test_shadow_rate_env_parsing(monkeypatch):
+    monkeypatch.delenv(numerics.SHADOW_RATE_ENV, raising=False)
+    assert numerics.shadow_rate() == 0
+    monkeypatch.setenv(numerics.SHADOW_RATE_ENV, "8")
+    assert numerics.shadow_rate() == 8
+    monkeypatch.setenv(numerics.SHADOW_RATE_ENV, "junk")
+    assert numerics.shadow_rate() == 0
+    monkeypatch.setenv(numerics.SHADOW_RATE_ENV, "-3")
+    assert numerics.shadow_rate() == 0
+
+
+# ------------------------------------------------------ drift measure
+
+def test_measure_drift():
+    a = np.ones(8, dtype=np.float32)
+    assert numerics.measure_drift(a, a) == (0.0, 0)
+    rel, ulps = numerics.measure_drift(a * np.float32(1.001), a)
+    assert 0 < rel < 2e-3 and ulps > 0
+    rel, ulps = numerics.measure_drift(np.ones(4, np.float32),
+                                       np.ones(5, np.float32))
+    assert rel == float("inf") and ulps == -1
+    rel, ulps = numerics.measure_drift(np.array([np.nan], np.float32),
+                                       np.array([1.0], np.float32))
+    assert rel == float("inf") and ulps == -1
+    # integer outputs: rel-L2 over the cast, no ulp notion
+    assert numerics.measure_drift(np.arange(4), np.arange(4)) == (0.0, 0)
+
+
+# ------------------------------------------------------- error budget
+
+def test_budget_burns_after_sustained_over_and_recovers():
+    b = numerics.DriftBudget(target=0.1, short_n=4, long_n=8,
+                             min_samples=4, burn_threshold=2.0,
+                             hysteresis=0.5)
+    burning = False
+    for _ in range(4):
+        burning = b.observe("op", "r", True, rel_l2=0.5)
+    assert burning and b.burning("op", "r")
+    assert len(trace.events("drift-budget-burn")) == 1
+    # clean samples flush the short window under threshold * hysteresis
+    for _ in range(4):
+        burning = b.observe("op", "r", False)
+    assert not burning and not b.burning("op", "r")
+    assert len(trace.events("drift-budget-ok")) == 1
+    st = b.state()["op|r"]
+    assert st["samples"] == 8 and st["over"] == 4
+
+
+def test_budget_needs_min_samples():
+    b = numerics.DriftBudget(target=0.1, short_n=4, long_n=8, min_samples=6)
+    for _ in range(5):
+        assert not b.observe("op", "r", True)
+    assert b.observe("op", "r", True)   # the 6th over-sample fires
+
+
+def test_budget_rejects_nonpositive_target():
+    with pytest.raises(ValueError):
+        numerics.DriftBudget(target=0.0)
+
+
+# ---------------------------------------------------------- sentinels
+
+class _SpyBreaker:
+    def __init__(self):
+        self.calls = []
+
+    def record_failure(self, op, rung, kind):
+        self.calls.append((op, rung, kind))
+
+
+def test_sentinel_nan_trips_breaker():
+    br = _SpyBreaker()
+    bad = numerics.sentinel("serve.echo", "fast",
+                            [np.array([1.0, np.nan, np.inf], np.float32)],
+                            breaker=br)
+    assert bad == 2
+    ev = trace.events("numeric-sentinel")[-1]
+    assert ev["kind"] == "non-finite" and ev["count"] == 2 and ev["size"] == 3
+    assert br.calls == [("serve.echo", "fast", FailureKind.NUMERIC)]
+    assert metrics.counter("numerics.sentinel.tripped").value == 1
+
+
+def test_sentinel_range_check():
+    bad = numerics.sentinel("op", "r", [np.array([0.5, 2.0], np.float32)],
+                            lo=0.0, hi=1.0)
+    assert bad == 1
+    assert trace.events("numeric-sentinel")[-1]["kind"] == "out-of-range"
+
+
+def test_sentinel_clean_batch_is_silent():
+    assert numerics.sentinel("op", "r", [np.ones(16, np.float32)],
+                             lo=0.0, hi=2.0) == 0
+    assert not trace.events("numeric-sentinel")
+    # non-float outputs are skipped entirely (bitwise workloads)
+    assert numerics.sentinel("op", "r", [np.arange(8, dtype=np.uint8)]) == 0
+
+
+# --------------------------------------------------------- convergence
+
+def test_convergence_tracker_stall_verdict():
+    tr = numerics.ConvergenceTracker("solve", stall_epochs=3)
+    for step, res in enumerate((1.0, 0.5, 0.25)):
+        tr.step(step, res, res, 10.0)
+    assert not tr.stalled
+    for step in range(3, 6):               # residual stops improving
+        tr.step(step, 0.25, 0.0, 10.0)
+    assert tr.stalled
+    evs = trace.events("solver-progress")
+    assert len(evs) == 6
+    assert evs[0]["op"] == "solve" and evs[-1]["step"] == 5
+    # improvement resets the stall counter
+    tr.step(6, 0.1, 0.15, 10.0)
+    assert not tr.stalled
+
+
+def test_progress_from_states_residual_math():
+    tr = numerics.ConvergenceTracker("solve")
+    old = np.ones((4, 4), np.float32)
+    new = old * np.float32(1.5)
+    numerics.progress_from_states(tr, 3, old, new, iters=4, elapsed_s=2.0)
+    ev = trace.events("solver-progress")[-1]
+    assert ev["step"] == 3 and ev["iters_per_s"] == 2.0
+    assert ev["residual"] == pytest.approx(0.5 / 1.5, rel=1e-6)
+    # mismatched shapes (resharded state) are skipped, never raised
+    numerics.progress_from_states(tr, 4, np.ones(3), np.ones(5), 1, 1.0)
+    assert len(trace.events("solver-progress")) == 1
+
+
+def test_checkpointed_solves_emit_progress(tmp_path):
+    from cme213_tpu.apps.heat2d import run_heat_checkpointed
+    from cme213_tpu.config import SimParams
+
+    run_heat_checkpointed(SimParams(nx=16, ny=16, order=2, iters=6),
+                          str(tmp_path / "ckpt"), every=2)
+    evs = [e for e in trace.events("solver-progress")
+           if e["op"] == "heat2d"]
+    assert len(evs) == 3                    # one per chunk
+    assert all(e["residual"] >= 0 for e in evs)
+
+
+# ------------------------------------------------- fleet-level SLO kind
+
+def test_slo_drift_rate_objective_burns():
+    clock = VirtualClock()
+    mon = slo_mod.from_flags(clock, drift_rate=0.1, short_s=5.0,
+                             long_s=10.0, min_samples=4)
+    for _ in range(4):
+        mon.observe(latency_ms=1.0, drift=True)
+        clock.advance(0.1)
+    state = mon.evaluate()
+    assert state["drift-rate"]["burning"]
+    assert any(e["objective"] == "drift-rate"
+               for e in trace.events("slo-burn"))
+    # non-shadow samples are invisible to the drift objective
+    mon2 = slo_mod.from_flags(clock, drift_rate=0.1, min_samples=1)
+    mon2.observe(latency_ms=1.0)
+    assert mon2.evaluate()["drift-rate"]["burn_short"] is None
+
+
+# ------------------------------------------------------ CLI + summary
+
+def _write_sink(tmp_path):
+    recs = [
+        {"event": "numeric-drift", "t": 1.0, "op": "serve.echo",
+         "rung": "fast", "shape_class": "k", "rel_l2": 0.5, "max_ulps": 9,
+         "over_budget": True},
+        {"event": "numeric-drift", "t": 2.0, "op": "serve.echo",
+         "rung": "fast", "shape_class": "k", "rel_l2": 0.0, "max_ulps": 0,
+         "over_budget": False},
+        {"event": "drift-budget-burn", "t": 3.0, "op": "serve.echo",
+         "rung": "fast", "burn_short": 10.0, "burn_long": 10.0,
+         "threshold": 2.0},
+        {"event": "numeric-sentinel", "t": 4.0, "op": "serve.heat",
+         "rung": "xla", "kind": "non-finite", "count": 3, "size": 64},
+        {"event": "solver-progress", "t": 5.0, "op": "heat2d", "step": 1,
+         "residual": 0.5, "delta_norm": 1.0, "iters_per_s": 3.0},
+        {"event": "solver-progress", "t": 6.0, "op": "heat2d", "step": 2,
+         "residual": 0.25, "delta_norm": 0.5, "iters_per_s": 3.0},
+    ]
+    sink = tmp_path / "trace.jsonl"
+    sink.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    return str(sink)
+
+
+def test_numerics_cli_report_and_gates(tmp_path, capsys):
+    from cme213_tpu import numerics_cli
+
+    sink = _write_sink(tmp_path)
+    assert numerics_cli.main(["report", sink, "--max-over-budget", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "2 shadow sample(s)" in out and "DEMOTED serve.echo.fast" in out
+    assert "solver heat2d" in out and "converging" in out
+
+    assert numerics_cli.main(["report", sink, "--max-over-budget", "0"]) == 1
+    assert "over the drift budget" in capsys.readouterr().err
+    assert numerics_cli.main(["report", sink, "--min-samples", "3"]) == 1
+    assert numerics_cli.main(["report", sink, "--forbid-stall"]) == 0
+
+    doc = numerics_cli.report([sink])
+    assert doc["numerics"]["samples"] == 2
+    assert doc["numerics"]["over_budget"] == 1
+    assert doc["numerics"]["demotions"] == ["serve.echo.fast"]
+    assert doc["numerics"]["sentinels"]["trips"] == 1
+    assert doc["convergence"]["heat2d"]["epochs"] == 2
+    assert not doc["convergence"]["heat2d"]["stalled"]
+
+
+def test_numerics_cli_forbid_stall_gate(tmp_path):
+    from cme213_tpu import numerics_cli
+
+    recs = [{"event": "solver-progress", "t": float(i), "op": "s",
+             "step": i, "residual": 1.0, "delta_norm": 0.0,
+             "iters_per_s": 1.0} for i in range(7)]
+    sink = tmp_path / "stalled.jsonl"
+    sink.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    assert numerics_cli.main(["report", str(sink)]) == 0
+    assert numerics_cli.main(["report", str(sink), "--forbid-stall"]) == 1
+    doc = numerics_cli.report([str(sink)])
+    assert doc["convergence"]["s"]["stalled"]
+
+
+def test_trace_summary_numeric_sections(tmp_path):
+    import io
+
+    from cme213_tpu.trace_cli import load_events, summarize
+
+    sink = _write_sink(tmp_path)
+    buf = io.StringIO()
+    agg = summarize(load_events([sink]), out=buf)
+    text = buf.getvalue()
+    assert "numeric health:" in text and "convergence:" in text
+    assert agg["numerics"]["samples"] == 2
+    assert agg["numerics"]["drift"]["serve.echo.fast"]["over_budget"] == 1
+    assert agg["convergence"]["heat2d"]["last_residual"] == 0.25
+    # --require consumes event names through the counts table
+    assert agg["counts"]["numeric-drift"] == 2
+
+
+def test_flight_dump_embeds_drift_snapshot(tmp_path, monkeypatch):
+    from cme213_tpu.core import flight
+
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(tmp_path))
+    b = numerics.budget()
+    for _ in range(b.min_samples):
+        b.observe("serve.echo", "fast", True, rel_l2=0.5)
+    numerics._DEMOTED.add(("serve.echo", "fast"))
+    path = flight.dump("test-reason")
+    doc = json.loads(open(path).read())
+    assert doc["numerics"]["demoted"] == ["serve.echo|fast"]
+    assert doc["numerics"]["budget"]["serve.echo|fast"]["burning"]
