@@ -114,7 +114,7 @@ def _new_row() -> dict:
     return {"pid": None, "incarnation": 0, "state": "unknown", "step": None,
             "heartbeat_t": None, "last_span": None, "last_event": None,
             "last_t": None, "breakers_open": 0, "degraded": False,
-            "events": 0, "metrics": None}
+            "events": 0, "metrics": None, "role": None, "occupancy": None}
 
 
 class Collector:
@@ -185,9 +185,12 @@ class Collector:
         row["events"] += 1
         row["last_event"] = event
         row["last_t"] = t
-        if event != "rank-failed":
-            # rank-failed is the LAUNCHER reporting on a worker's rank:
-            # its pid is the launcher's — never the condemned worker's
+        if event not in ("rank-failed", "replica-down"):
+            # rank-failed / replica-down are a supervisor reporting on a
+            # condemned worker: the record mixes the emitter's identity
+            # with the worker's (launcher pid + worker rank; front-tier
+            # rank + replica incarnation) — folding it into either row's
+            # pid/incarnation state would cross-contaminate them
             row["pid"] = rec.get("pid", row["pid"])
             inc = rec.get("incarnation", row["incarnation"]) or 0
             if inc != row["incarnation"]:
@@ -229,6 +232,32 @@ class Collector:
             row["breakers_open"] = max(0, row["breakers_open"] - 1)
         elif event == "request-served":
             self.fleet["requests"] += 1
+        elif event == "replica-up":
+            # emitted by the replica worker itself: its row is `key`
+            self.fleet["replica_ups"] += 1
+            row.update(role="replica", state="running")
+        elif event == "replica-down":
+            # emitted by the fleet front tier ABOUT a replica — like
+            # rank-failed, the condemned row is the replica's, not the
+            # emitter's
+            self.fleet["replica_downs"] += 1
+            target = self.ranks.get(f"r{rec.get('replica')}")
+            if target is not None:
+                target["state"] = ("retired"
+                                   if rec.get("reason") == "retired"
+                                   else "down")
+        elif event == "request-routed":
+            self.fleet["routed"] += 1
+        elif event == "request-requeued":
+            self.fleet["requeues"] += 1
+        elif event == "scale-up":
+            self.fleet["scale_ups"] += 1
+        elif event == "scale-down":
+            self.fleet["scale_downs"] += 1
+        elif event == "batch-executed":
+            occ = rec.get("occupancy")
+            if isinstance(occ, (int, float)):
+                row["occupancy"] = occ
         elif event == "conformance-failed":
             self.fleet["conformance_failures"] += 1
         elif event == "attribution-mismatch":

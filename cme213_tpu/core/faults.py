@@ -40,6 +40,18 @@ Spec grammar (comma-separated clauses)::
                                   process's first incarnation
                                   (``CME213_INCARNATION`` unset or 0) — so a
                                   launcher restart survives deterministically
+    replica-kill:<rank>[:<nth>]   ``maybe_kill_replica()`` SIGKILLs the
+                                  serving replica whose
+                                  ``JAX_PROCESS_ID == rank`` on the <nth>
+                                  guarded batch (1-based, default 1) —
+                                  mid-batch, after requests are accepted
+                                  and queued but before they execute, so
+                                  the fleet's zero-loss requeue path
+                                  (``serve/fleet.py``) is deterministically
+                                  testable; the flight recorder dumps
+                                  first (SIGKILL skips atexit); first
+                                  incarnation only, so the relaunched
+                                  replica serves clean
     wrong:<op>[:<nth>]            the <nth> call of ``maybe_perturb(op, v)``
                                   returns ``v`` with ONE element of its
                                   first float leaf perturbed (finite, large)
@@ -131,10 +143,10 @@ class FaultSpecError(ValueError):
 
 @dataclass
 class _Clause:
-    kind: str           # fail | nan | ckpt | rankkill | wrong | oom | slow
-                        # | unreachable | stage | drift
-    op: str             # op name ("truncate" for ckpt; rank id for rankkill;
-                        # "*" for the op-agnostic unreachable)
+    kind: str           # fail | nan | ckpt | rankkill | replica-kill | wrong
+                        # | oom | slow | unreachable | stage | drift
+    op: str             # op name ("truncate" for ckpt; rank id for rankkill/
+                        # replica-kill; "*" for the op-agnostic unreachable)
     nth: int = 1        # 1-based trigger call (rankkill: 0-based step)
     count: int = 1      # consecutive triggered calls (fail/slow/unreachable)
     ms: float = 0.0     # injected latency (slow) / relative scale (drift)
@@ -160,15 +172,17 @@ class FaultPlan:
                 continue
             parts = raw.split(":")
             kind = parts[0]
-            if (kind not in ("fail", "nan", "ckpt", "rankkill", "wrong",
-                             "oom", "slow", "unreachable", "stage", "drift")
+            if (kind not in ("fail", "nan", "ckpt", "rankkill",
+                             "replica-kill", "wrong", "oom", "slow",
+                             "unreachable", "stage", "drift")
                     or len(parts) < 2):
                 raise FaultSpecError(
                     f"bad fault clause {raw!r} (kinds: fail:<op>[:nth[:count]]"
                     f", nan:<op>[:nth], wrong:<op>[:nth], oom:<op>[:nth], "
                     f"drift:<op>[:scale[:nth]], "
                     f"slow:<op>[:ms[:nth[:count]]], ckpt:truncate[:nth], "
-                    f"rankkill:<rank>[:step], unreachable:<nth>[:count], "
+                    f"rankkill:<rank>[:step], replica-kill:<rank>[:nth], "
+                    f"unreachable:<nth>[:count], "
                     f"stage:<op>:<stage>[:nth[:count]])")
             try:
                 if kind == "fail":
@@ -220,6 +234,10 @@ class FaultPlan:
                     if parts[1] not in ("truncate", "commit"):
                         raise FaultSpecError(
                             f"unknown ckpt fault {parts[1]!r}")
+                    clauses.append(_Clause(
+                        kind, parts[1],
+                        nth=int(parts[2]) if len(parts) > 2 else 1))
+                elif kind == "replica-kill":
                     clauses.append(_Clause(
                         kind, parts[1],
                         nth=int(parts[2]) if len(parts) > 2 else 1))
@@ -531,3 +549,36 @@ def maybe_kill_rank(step: int | None = None) -> None:
             from . import flight
             flight.dump("rankkill")
             os._exit(KILL_EXIT)
+
+
+def maybe_kill_replica() -> None:
+    """SIGKILL this serving replica if a ``replica-kill`` clause matches
+    this rank on this guarded batch, first incarnation only.
+
+    The replica worker (``serve/fleet.py``) calls this once per batch,
+    after requests have been accepted into its queue but before they
+    execute — the exact window where the fleet's in-flight requeue path
+    must prove zero accepted-request loss.  SIGKILL (unlike ``os._exit``)
+    is how an OOM-killed or preempted replica actually dies, so the
+    flight recorder dumps *before* the signal is raised.
+    """
+    plan = active()
+    if plan is None:
+        return
+    rank = os.environ.get("JAX_PROCESS_ID", "0")
+    for c in plan.clauses:
+        if c.kind != "replica-kill" or c.op != rank:
+            continue
+        if c.fires() and incarnation() == 0:
+            _record("replica-kill", rank, call=c.calls)
+            sys.stderr.write(
+                f"[faults] injected replica kill: rank {rank} at batch "
+                f"{c.calls}\n")
+            sys.stderr.flush()
+            # SIGKILL skips atexit AND signal handlers — the flight
+            # recorder must dump here or the event ring dies with us
+            import signal
+
+            from . import flight
+            flight.dump("replica-kill")
+            os.kill(os.getpid(), signal.SIGKILL)

@@ -149,6 +149,13 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     # SLO burn-rate monitor (serve/slo.py)
     "slo-burn": ("objective", "burn_short", "burn_long", "threshold"),
     "slo-ok": ("objective", "burn_short"),
+    # replicated serving fleet (serve/fleet.py, serve/router.py)
+    "replica-up": ("replica", "incarnation", "addr"),
+    "replica-down": ("replica", "incarnation", "reason"),
+    "request-routed": ("rid", "op", "tenant", "replica"),
+    "request-requeued": ("rid", "op", "tenant", "from_replica"),
+    "scale-up": ("replicas", "reason"),
+    "scale-down": ("replicas", "reason"),
     # numeric-health observatory (core/numerics.py): shadow conformance
     # sampling, output sentinels, convergence tracing
     "numeric-drift": ("op", "rung", "shape_class", "rel_l2", "max_ulps",

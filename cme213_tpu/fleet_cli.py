@@ -1,0 +1,137 @@
+"""``python -m cme213_tpu fleet`` — run the replicated serving fleet.
+
+Two subcommands:
+
+- ``up``: spawn N replica worker processes, start the tenant-fair front
+  tier (``serve/fleet.py``), print/write the listen address, and serve
+  until ``--max-seconds`` elapses or the process is terminated.  Drive
+  it with ``python -m cme213_tpu serve loadgen --transport <addr>``.
+- ``worker``: one replica process — spawned by ``up``; not normally run
+  by hand.
+
+Example (two replicas, open-loop load, one replica killed mid-run by an
+injected fault — zero accepted-request loss)::
+
+    CME213_FAULTS="replica-kill:1:2" CME213_FLIGHT_DIR=/tmp/fl \\
+        python -m cme213_tpu fleet up --replicas 2 --addr-file /tmp/addr &
+    python -m cme213_tpu serve loadgen --transport "$(cat /tmp/addr)" \\
+        --mode open --requests 48 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _up_main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleet up",
+        description="run a replicated serving fleet behind one socket "
+                    "front end")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--port", type=int, default=0,
+                    help="front-end port (0 = ephemeral; see --addr-file)")
+    ap.add_argument("--addr-file", default=None,
+                    help="write the bound host:port here once listening")
+    ap.add_argument("--capacity", type=int, default=64,
+                    help="per-replica server queue capacity")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--mix", default="spmv,heat,cipher",
+                    help="warmup mix each replica pre-compiles on start")
+    ap.add_argument("--warm-requests", type=int, default=6)
+    ap.add_argument("--dispatch-width", type=int, default=None,
+                    help="concurrent sends per replica (default max-batch)")
+    ap.add_argument("--max-seconds", type=float, default=300.0)
+    ap.add_argument("--ready-timeout-s", type=float, default=180.0)
+    ap.add_argument("--autoscale", action="store_true",
+                    help="arm the SLO-burn autoscaler (needs --slo-p99-ms)")
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=4)
+    ap.add_argument("--slo-p99-ms", type=float, default=None,
+                    help="p99 objective feeding the autoscaler's burn "
+                    "signal")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the final fleet stats as JSON on exit")
+    args = ap.parse_args(argv)
+
+    from .core.resilience import Clock
+    from .serve import slo as slo_mod
+    from .serve.fleet import Fleet
+    from .serve.router import Autoscaler
+
+    clock = Clock()
+    slo = None
+    autoscaler = None
+    if args.slo_p99_ms is not None:
+        slo = slo_mod.from_flags(clock, p99_ms=args.slo_p99_ms,
+                                 shed_rate=None, error_rate=None,
+                                 drift_rate=None, short_s=5.0, long_s=60.0,
+                                 burn_threshold=2.0, min_samples=10)
+    if args.autoscale:
+        if slo is None:
+            print("fleet up: --autoscale needs --slo-p99-ms",
+                  file=sys.stderr)
+            return 2
+        autoscaler = Autoscaler(clock=clock,
+                                min_replicas=args.min_replicas,
+                                max_replicas=args.max_replicas)
+
+    fleet = Fleet(replicas=args.replicas, capacity=args.capacity,
+                  max_batch=args.max_batch, mix=args.mix,
+                  warm_requests=args.warm_requests,
+                  dispatch_width=args.dispatch_width,
+                  port=args.port, ready_timeout_s=args.ready_timeout_s,
+                  slo=slo, autoscaler=autoscaler, clock=clock)
+    try:
+        fleet.start()
+    except TimeoutError as e:
+        print(f"fleet up: {e}", file=sys.stderr)
+        return 1
+    # banner to stderr under --json so stdout stays one parseable doc
+    print(f"fleet: listening on {fleet.addr} "
+          f"({args.replicas} replica(s))", flush=True,
+          file=sys.stderr if args.as_json else sys.stdout)
+    if args.addr_file:
+        with open(args.addr_file, "w") as f:
+            f.write(fleet.addr)
+    try:
+        deadline = time.monotonic() + args.max_seconds
+        while time.monotonic() < deadline:
+            time.sleep(0.25)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stats = fleet.stats()
+        fleet.close()
+    if args.as_json:
+        print(json.dumps(stats, indent=2))
+    else:
+        print(f"fleet: done; {stats['requeues']} requeue(s), "
+              f"scale +{stats['scale_ups']}/-{stats['scale_downs']}")
+    return 0
+
+
+def main(argv: list[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m cme213_tpu fleet <up|worker> [args...]\n\n"
+              "subcommands:\n"
+              "  up      spawn N supervised server replicas behind a "
+              "tenant-fair socket front end\n"
+              "  worker  one replica process (spawned by `up`)")
+        return 0 if argv else 2
+    if argv[0] == "up":
+        return _up_main(argv[1:])
+    if argv[0] == "worker":
+        from .serve.fleet import worker_main
+
+        return worker_main(argv[1:])
+    print(f"fleet: unknown subcommand {argv[0]!r} (try up | worker)",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -113,6 +113,12 @@ def _numerics(argv: list[str]) -> int:
     return numerics_cli.main(argv)
 
 
+def _fleet(argv: list[str]) -> int:
+    from . import fleet_cli
+
+    return fleet_cli.main(argv)
+
+
 WORKLOADS: dict[str, Workload] = {
     w.name: w
     for w in (
@@ -177,6 +183,15 @@ WORKLOADS: dict[str, Workload] = {
                  "solver convergence/stall); --json for CI, "
                  "--max-over-budget/--forbid-stall gate with exit 1",
                  _numerics),
+        # not a reference workload: the replicated serving tier — the
+        # hw5 gang machinery (supervised relaunch, incarnations,
+        # per-rank sinks) repurposed for N independent server replicas
+        # behind a tenant-fair, SLO-burn-autoscaling front end
+        Workload("fleet", "serving", "up: run a replicated serving fleet "
+                 "(socket front end, tenant-fair router, per-replica "
+                 "breakers, supervised relaunch with zero accepted-"
+                 "request loss, SLO-burn autoscaling); worker: one "
+                 "replica process (spawned by up)", _fleet),
     )
 }
 

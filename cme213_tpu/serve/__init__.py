@@ -7,6 +7,13 @@ device program), per-request deadlines, memory-budget admission, a
 per-(op, rung) circuit breaker over the fallback ladders, and graceful
 degradation under pressure — every refusal structured, every mode shift
 visible in ``trace summary``.  See ``docs/serving.md``.
+
+Beyond the single in-process server, ``transport.py`` adds a concurrent
+socket front end (length-prefixed JSON frames; the caller-driven
+``step()`` loop becomes one of two drive modes), and ``router.py`` +
+``fleet.py`` replicate the server across supervised worker processes
+with tenant-fair routing and SLO-burn autoscaling (``python -m
+cme213_tpu fleet up``).
 """
 
 from .request import (  # noqa: F401
@@ -25,6 +32,15 @@ from .server import BoundedQueue, Server, tuned_batch_cap  # noqa: F401
 from .slo import Objective, SLOMonitor  # noqa: F401
 from .workloads import ADAPTERS, CipherRequest  # noqa: F401
 
+# socket transport / replicated fleet (imported lazily by consumers to
+# keep `import cme213_tpu.serve` light: no sockets, no subprocess)
+__all__ = [
+    "ADAPTERS", "ADMISSION", "BoundedQueue", "CipherRequest", "DEADLINE",
+    "FAILED", "OK", "Objective", "PHASES", "QUEUE_FULL", "RequestSpec",
+    "SHED", "SLOMonitor", "Server", "SolveRequest", "SolveResult",
+    "tuned_batch_cap",
+]
+
 
 def main(argv: list[str]) -> int:
     """``python -m cme213_tpu serve <subcommand>`` dispatcher."""
@@ -38,7 +54,10 @@ def main(argv: list[str]) -> int:
               "an SLO report\n"
               "  warmup    pre-compile the canonical serving buckets "
               "(with CME213_COMPILE_CACHE set, into the persistent disk "
-              "cache for warm process starts)")
+              "cache for warm process starts)\n\n"
+              "loadgen --transport HOST:PORT drives a socket front end "
+              "(see `python -m cme213_tpu fleet`) with real concurrent "
+              "client threads")
         return 0 if argv else 2
     if argv[0] == "loadgen":
         from . import loadgen

@@ -128,6 +128,110 @@ def run_load(server: Server, specs: list[RequestSpec],
     return {"results": results, "elapsed_s": clock.now() - t0}
 
 
+def run_load_transport(addr: str, specs: list[RequestSpec],
+                       mode: str = "closed", concurrency: int = 8,
+                       burst: int = 16,
+                       burst_interval_s: float = 0.005) -> dict:
+    """Drive a socket front end (``serve/transport.py`` — one server or
+    a whole fleet) with **real concurrent client threads**, which the
+    in-process :func:`run_load` cannot do.  Closed keeps ``concurrency``
+    connections each with one request in flight; open fires every
+    request in its own thread, ``burst`` at a time, arrivals ignoring
+    completions — genuine concurrent pressure on the accept path."""
+    import threading
+    import time as time_mod
+
+    from .request import SolveResult
+    from .transport import TransportClient
+
+    results: list = []
+    mu = threading.Lock()
+
+    def _failed(spec: RequestSpec, err: Exception) -> SolveResult:
+        return SolveResult(-1, spec.op, FAILED, reason="transport",
+                           tenant=spec.tenant)
+
+    t0 = time_mod.monotonic()
+    if mode == "closed":
+        remaining = list(specs)
+
+        def worker() -> None:
+            client = None
+            while True:
+                with mu:
+                    if not remaining:
+                        break
+                    spec = remaining.pop(0)
+                try:
+                    if client is None:
+                        client = TransportClient(addr)
+                    res = client.solve(spec.op, spec.payload,
+                                       deadline_ms=spec.deadline_ms,
+                                       tenant=spec.tenant)
+                except (OSError, ConnectionError, ValueError) as e:
+                    if client is not None:
+                        client.close()
+                    client = None
+                    res = _failed(spec, e)
+                with mu:
+                    results.append(res)
+            if client is not None:
+                client.close()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(max(1, min(concurrency, len(specs))))]
+    elif mode == "open":
+        def fire(spec: RequestSpec) -> None:
+            try:
+                with TransportClient(addr) as client:
+                    res = client.solve(spec.op, spec.payload,
+                                       deadline_ms=spec.deadline_ms,
+                                       tenant=spec.tenant)
+            except (OSError, ConnectionError, ValueError) as e:
+                res = _failed(spec, e)
+            with mu:
+                results.append(res)
+
+        threads = [threading.Thread(target=fire, args=(spec,), daemon=True)
+                   for spec in specs]
+    else:
+        raise ValueError(f"unknown mode {mode!r} (closed | open)")
+
+    if mode == "open":
+        # arrivals ignore completions: launch in bursts, never wait
+        for i, t in enumerate(threads):
+            t.start()
+            if burst and (i + 1) % burst == 0:
+                time_mod.sleep(burst_interval_s)
+    else:
+        for t in threads:
+            t.start()
+    for t in threads:
+        t.join()
+    return {"results": results, "elapsed_s": time_mod.monotonic() - t0}
+
+
+def fleet_section(run: dict, addr: str) -> dict:
+    """The SLO report's ``fleet`` section for a ``--transport`` run:
+    which replicas served (stamped on each wire response), plus the
+    front tier's own routing stats via a ``stats`` control frame."""
+    from .transport import TransportClient
+
+    seen = sorted({r.replica for r in run["results"]
+                   if getattr(r, "replica", None) is not None})
+    section: dict = {"replicas_seen": [f"r{n}" for n in seen]}
+    try:
+        with TransportClient(addr, timeout_s=5.0) as client:
+            stats = client.control("stats").get("stats") or {}
+    except (OSError, ConnectionError, ValueError):
+        stats = {}
+    for key in ("replicas_up", "requeues", "scale_ups", "scale_downs",
+                "occupancy", "backlog", "replicas", "flight_confirmed"):
+        if key in stats:
+            section[key] = stats[key]
+    return section
+
+
 def compile_attribution(before: dict, after: dict) -> dict:
     """Per-shape-class compile-vs-run attribution from the metrics delta:
     how much of the pass went to (re)tracing (``compile.<op>.<class>.ms``)
@@ -367,6 +471,21 @@ def format_report(report: dict) -> str:
             f"{num['sentinel_trips']} sentinel trip(s)")
         for key in num.get("demoted") or []:
             lines.append(f"  DEMOTED {key}")
+    fleet = report.get("fleet")
+    if fleet:
+        seen = ", ".join(fleet.get("replicas_seen") or []) or "-"
+        lines.append(
+            f"fleet: replicas seen {seen}; "
+            f"{fleet.get('requeues', 0)} requeue(s); "
+            f"scale +{fleet.get('scale_ups', 0)}/-"
+            f"{fleet.get('scale_downs', 0)}")
+        for label in sorted(fleet.get("replicas") or {}):
+            row = fleet["replicas"][label]
+            lines.append(
+                f"  {label}: routed {row.get('routed', 0)}, "
+                f"requeues {row.get('requeues', 0)}, "
+                f"breaker {row.get('breaker', '?')}"
+                f"{'' if row.get('up') else '  DOWN'}")
     if "baseline" in report:
         b = report["baseline"]
         lines.append(f"baseline (max_batch=1): {b['throughput_rps']} req/s "
@@ -426,12 +545,29 @@ def main(argv: list[str]) -> int:
                     "many compile retraces (the steady-state gate: with the "
                     "program cache every shape class compiles at most once, "
                     "so 0 is the expected value)")
+    ap.add_argument("--transport", default=None, metavar="HOST:PORT",
+                    help="drive a socket front end (serve/transport.py or "
+                    "a fleet) with real concurrent client threads instead "
+                    "of an in-process server; the report gains a fleet "
+                    "section")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
     flight.install()   # a crashing load run leaves its black box behind
     specs = build_mix(args.mix, args.requests, seed=args.seed,
                       deadline_ms=args.deadline_ms, tenants=args.tenants)
+
+    if args.transport:
+        before = metrics.snapshot()
+        run = run_load_transport(args.transport, specs, mode=args.mode,
+                                 concurrency=args.concurrency,
+                                 burst=args.burst)
+        report = slo_report(run, before, metrics.snapshot())
+        report["fleet"] = fleet_section(run, args.transport)
+        print(json.dumps(report, indent=2) if args.as_json
+              else format_report(report))
+        return 0
+
     last_slo = None
 
     def make_server(max_batch: int) -> Server:

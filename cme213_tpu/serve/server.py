@@ -150,6 +150,11 @@ class Server:
         self._rids = itertools.count()
         self._admit_cache: dict[tuple, int] = {}
         self._tuned_caps: dict[tuple, int] = {}
+        # drive-mode hook: the caller-driven step() loop is the default
+        # drive; a transport front end (serve/transport.py) attaches a
+        # waker so its background batcher thread wakes on arrival instead
+        # of polling.  Called after every successful enqueue.
+        self.on_submit = None
 
     # ------------------------------------------------------------ submit
 
@@ -189,6 +194,8 @@ class Server:
                               timing=req.timing(), trace_id=req.trace_id)
             self._observe_slo(res)
             return res
+        if self.on_submit is not None:
+            self.on_submit()
         return rid
 
     def _shed_deadline(self, req: SolveRequest, late_ms: float,

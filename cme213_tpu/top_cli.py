@@ -54,15 +54,19 @@ def render_top(state: dict, out=None) -> None:
     out.write(f"cme213 fleet · {len(state['ranks'])} proc(s) · "
               f"{state['events']} event(s) · trace {trace or '-'}\n")
 
-    out.write(f"{'PROC':<7}{'STATE':<9}{'PID':<8}{'INC':<4}{'STEP':<7}"
-              f"{'HB AGE':<8}{'LAST SPAN':<22}{'FLAGS'}\n")
+    out.write(f"{'PROC':<7}{'ROLE':<9}{'STATE':<9}{'PID':<8}{'INC':<4}"
+              f"{'STEP':<7}{'HB AGE':<8}{'OCC':<6}{'LAST SPAN':<22}"
+              f"{'FLAGS'}\n")
     for key, row in state["ranks"].items():
         hb = row.get("heartbeat_age_s")
-        out.write(_fmt(key, 7) + _fmt(row.get("state"), 9)
+        occ = row.get("occupancy")
+        out.write(_fmt(key, 7) + _fmt(row.get("role"), 9)
+                  + _fmt(row.get("state"), 9)
                   + _fmt(row.get("pid"), 8)
                   + _fmt(row.get("incarnation"), 4)
                   + _fmt(row.get("step"), 7)
                   + _fmt(f"{hb:.1f}s" if hb is not None else None, 8)
+                  + _fmt(f"{occ:.2f}" if occ is not None else None, 6)
                   + _fmt(row.get("last_span"), 22)
                   + _flags(row) + "\n")
 
@@ -80,6 +84,15 @@ def render_top(state: dict, out=None) -> None:
               f" slo_burns={fl.get('slo_burns', 0)}"
               f" breaker_opens={fl.get('breaker_opens', 0)}"
               f" requests={fl.get('requests', 0)}\n")
+    if any(fl.get(k) for k in ("replica_ups", "replica_downs", "routed",
+                               "requeues", "scale_ups", "scale_downs")):
+        out.write("serving: "
+                  f"replicas_up={fl.get('replica_ups', 0)} "
+                  f"replicas_down={fl.get('replica_downs', 0)} "
+                  f"routed={fl.get('routed', 0)} "
+                  f"requeues={fl.get('requeues', 0)} "
+                  f"scale=+{fl.get('scale_ups', 0)}"
+                  f"/-{fl.get('scale_downs', 0)}\n")
     out.write("numerics: "
               f"drift={fl.get('drift_samples', 0)}"
               f"/{fl.get('drift_over_budget', 0)}over "

@@ -466,6 +466,58 @@ def summarize(events: list[dict], out=None) -> dict:
                 w(f"  breaker {transition.replace('_', '-')}: "
                   f"{target[0]}.{target[1]}\n")
 
+    # replicated fleet (serve/fleet.py + serve/router.py): per-replica
+    # routing stats from the front tier's lifecycle + routing events —
+    # the zero-accepted-request-loss evidence lives here (requeues)
+    fleet_sec = None
+    routed = [e for e in events if e["event"] == "request-routed"]
+    requeued = [e for e in events if e["event"] == "request-requeued"]
+    rep_ups = [e for e in events if e["event"] == "replica-up"]
+    rep_downs = [e for e in events if e["event"] == "replica-down"]
+    if routed or requeued or rep_ups or rep_downs:
+        per_rep: dict[str, dict] = {}
+
+        def _rep(label) -> dict:
+            return per_rep.setdefault(str(label), {
+                "routed": 0, "requeued": 0, "ups": 0, "downs": 0,
+                "breaker": "closed"})
+
+        for e in rep_ups:
+            _rep(f"r{e.get('replica')}")["ups"] += 1
+        for e in rep_downs:
+            _rep(f"r{e.get('replica')}")["downs"] += 1
+        for e in routed:
+            _rep(f"r{e.get('replica')}")["routed"] += 1
+        for e in requeued:
+            _rep(f"r{e.get('from_replica')}")["requeued"] += 1
+        # per-replica breaker state: the router keys its breaker
+        # (op="fleet.route") by rung "r<rank>" — last transition wins
+        for e in events:
+            if (e.get("op") == "fleet.route"
+                    and e["event"] in ("breaker-open", "breaker-half-open",
+                                       "breaker-close")):
+                _rep(e.get("rung"))["breaker"] = \
+                    e["event"].removeprefix("breaker-")
+        fleet_sec = {
+            "replicas": {k: per_rep[k] for k in sorted(per_rep)},
+            "routed": len(routed),
+            "requeues": len(requeued),
+            "replica_ups": len(rep_ups),
+            "replica_downs": len(rep_downs),
+            "scale_ups": sum(1 for e in events
+                             if e["event"] == "scale-up"),
+            "scale_downs": sum(1 for e in events
+                               if e["event"] == "scale-down"),
+        }
+        w(f"fleet: {len(per_rep)} replica(s), {len(routed)} routed, "
+          f"{len(requeued)} requeue(s), scale +{fleet_sec['scale_ups']}"
+          f"/-{fleet_sec['scale_downs']}\n")
+        for label, row in fleet_sec["replicas"].items():
+            w(f"  {label}: {row['routed']} routed, "
+              f"{row['requeued']} requeued, breaker {row['breaker']}"
+              + (f" [DOWN x{row['downs']}]" if row["downs"] else "")
+              + "\n")
+
     # request-lifecycle phase attribution: request-served events carry
     # the per-phase timing breakdown stamped by the server clock
     phases = None
@@ -715,6 +767,7 @@ def summarize(events: list[dict], out=None) -> dict:
             "attribution_mismatches": len(mismatches),
             "admission": {"rejected": len(rejected), "shrunk": len(shrunk)},
             "serving": serving,
+            "fleet": fleet_sec,
             "phases": phases,
             "tenants": tenants,
             "slo": slo,

@@ -1,0 +1,477 @@
+"""Fleet serving tier: wire codec bitwise round-trips, the socket
+transport's two drive modes, tenant-fair routing (deficit round robin),
+per-replica breakers, the zero-loss requeue ledger, and SLO-burn
+autoscaling hysteresis — all deterministic (``VirtualClock`` for every
+policy decision; sockets only where sockets are the thing under test).
+
+The e2e replica-kill arc (worker processes, SIGKILL, flight-recorder
+read-back) lives in the tier-1 fleet gate and in the ``slow``-marked
+test at the bottom; everything else here runs in-process.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cme213_tpu.core import faults, metrics, trace
+from cme213_tpu.core.resilience import VirtualClock
+from cme213_tpu.serve import OK, QUEUE_FULL, SHED, Server, SolveResult
+from cme213_tpu.serve.loadgen import build_mix
+from cme213_tpu.serve.router import ROUTE_OP, Autoscaler, Router
+from cme213_tpu.serve.transport import (
+    TransportClient,
+    TransportServer,
+    decode_payload,
+    decode_result,
+    decode_value,
+    encode_payload,
+    encode_result,
+    encode_value,
+    recv_frame,
+    send_frame,
+)
+from cme213_tpu.serve.workloads import ADAPTERS
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    trace.clear_events()
+    metrics.reset()
+    yield
+    faults.reset()
+    metrics.reset()
+
+
+def _bits(value) -> bytes:
+    return np.ascontiguousarray(np.asarray(value)).tobytes()
+
+
+# ------------------------------------------------------------ wire codec
+
+def test_frame_roundtrip_and_clean_eof():
+    a, b = socket.socketpair()
+    try:
+        doc = {"op": "cipher", "tenant": "t0", "nested": {"k": [1, 2.5]}}
+        send_frame(a, doc)
+        assert recv_frame(b) == doc
+        a.close()
+        assert recv_frame(b) is None          # EOF at a frame boundary
+    finally:
+        b.close()
+
+
+def test_nd_value_roundtrip_is_bitwise():
+    rng = np.random.default_rng(7)
+    for arr in (rng.standard_normal((5, 3)),
+                rng.standard_normal(17).astype(np.float32),
+                rng.integers(0, 255, 64).astype(np.uint8),
+                np.array(3.14159, dtype=np.float64)):
+        wire = json.loads(json.dumps(encode_value(arr)))
+        back = decode_value(wire)
+        assert back.dtype == arr.dtype and back.shape == arr.shape
+        assert back.tobytes() == arr.tobytes()
+    # containers recurse; scalars pass through
+    doc = encode_value({"xs": [np.arange(4), 2, "s"], "ok": True})
+    got = decode_value(json.loads(json.dumps(doc)))
+    assert got["ok"] is True and got["xs"][1] == 2 and got["xs"][2] == "s"
+    assert got["xs"][0].tobytes() == np.arange(4).tobytes()
+
+
+def test_payload_codecs_roundtrip_every_op():
+    specs = build_mix("spmv,heat,cipher", 6, seed=3)
+    assert {s.op for s in specs} == {"spmv_scan", "heat", "cipher"}
+    for spec in specs:
+        wire = json.loads(json.dumps(encode_payload(spec.op, spec.payload)))
+        back = decode_payload(spec.op, wire)
+        if spec.op == "spmv_scan":
+            for f in ("a", "s", "k", "x"):
+                assert _bits(getattr(back, f)) == _bits(
+                    getattr(spec.payload, f))
+            assert back.iters == spec.payload.iters
+        elif spec.op == "heat":
+            for f in ("nx", "ny", "alpha", "iters", "order", "ic"):
+                assert getattr(back, f) == getattr(spec.payload, f)
+        else:
+            assert _bits(back.text) == _bits(spec.payload.text)
+            assert back.shift == spec.payload.shift
+
+
+def test_payload_codec_rejects_unknown_op():
+    with pytest.raises(ValueError, match="no wire codec"):
+        encode_payload("spmv", None)   # mix name, not an adapter key
+
+
+def test_result_roundtrip_keeps_fields_and_extras():
+    res = SolveResult(rid=9, op="cipher", status=OK, reason=None,
+                      rung="jit", shape_class="c64", latency_ms=1.25,
+                      batch_size=3, degraded=False, tenant="t1",
+                      timing={"queue_ms": 0.5}, trace_id="abc",
+                      value=np.arange(6, dtype=np.uint8))
+    doc = json.loads(json.dumps(encode_result(res, replica=2)))
+    back = decode_result(doc)
+    for f in ("rid", "op", "status", "rung", "shape_class", "latency_ms",
+              "batch_size", "degraded", "tenant", "timing", "trace_id"):
+        assert getattr(back, f) == getattr(res, f)
+    assert back.value.tobytes() == res.value.tobytes()
+    assert getattr(back, "replica") == 2   # transport extra rides along
+
+
+# ------------------------------------------------------- drive modes
+
+def _serve_serial(specs):
+    """Reference values: each spec solved alone on a direct server."""
+    server = Server(adapters=ADAPTERS, clock=VirtualClock())
+    out = []
+    for spec in specs:
+        server.submit(spec.op, spec.payload, tenant=spec.tenant)
+        out.extend(server.drain())
+    return out
+
+
+def test_transport_caller_drive_pump_delivers():
+    server = Server(adapters=ADAPTERS, clock=VirtualClock(), max_batch=4)
+    ts = TransportServer(server, drive="caller").start()
+    try:
+        spec = build_mix("cipher", 1, seed=5)[0]
+        got = {}
+
+        def client():
+            with TransportClient(ts.addr) as c:
+                got["res"] = c.solve(spec.op, spec.payload)
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not len(server.queue):
+            time.sleep(0.01)
+        assert len(server.queue) == 1      # parked until the owner pumps
+        ts.pump()
+        t.join(10)
+        assert not t.is_alive()
+        res = got["res"]
+        assert res.status == OK
+        ref = _serve_serial([spec])[0]
+        assert _bits(res.value) == _bits(ref.value)
+    finally:
+        ts.close()
+
+
+def test_transport_caller_drive_sheds_at_the_door():
+    server = Server(adapters=ADAPTERS, clock=VirtualClock(), capacity=1)
+    ts = TransportServer(server, drive="caller").start()
+    try:
+        specs = build_mix("cipher", 2, seed=6)
+        got = {}
+
+        def first():
+            with TransportClient(ts.addr) as c:
+                got["first"] = c.solve(specs[0].op, specs[0].payload)
+
+        t = threading.Thread(target=first, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not len(server.queue):
+            time.sleep(0.01)
+        # queue full: the refusal comes back without any pumping
+        with TransportClient(ts.addr) as c:
+            shed = c.solve(specs[1].op, specs[1].payload)
+        assert shed.status == SHED and shed.reason == QUEUE_FULL
+        ts.pump()
+        t.join(10)
+        assert got["first"].status == OK
+    finally:
+        ts.close()
+
+
+def test_transport_thread_drive_concurrent_clients_bitwise():
+    server = Server(adapters=ADAPTERS, clock=VirtualClock(), max_batch=4)
+    ts = TransportServer(server, drive="thread").start()
+    try:
+        specs = build_mix("cipher", 8, seed=11, tenants=2)
+        results = [None] * len(specs)
+
+        def client(i, spec):
+            with TransportClient(ts.addr) as c:
+                results[i] = c.solve(spec.op, spec.payload,
+                                     tenant=spec.tenant)
+
+        threads = [threading.Thread(target=client, args=(i, s), daemon=True)
+                   for i, s in enumerate(specs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert all(r is not None and r.status == OK for r in results)
+        refs = _serve_serial(specs)
+        for res, ref in zip(results, refs):
+            assert _bits(res.value) == _bits(ref.value)
+        with TransportClient(ts.addr) as c:
+            assert c.control("ping")["ok"] is True
+            stats = c.control("stats")
+            assert stats["ok"] and stats["stats"]["queue_depth"] == 0
+            assert stats["stats"]["pending"] == 0
+            assert stats["stats"]["batches"] >= 1
+    finally:
+        ts.close()
+
+
+def test_transport_rejects_bad_drive_mode():
+    server = Server(adapters=ADAPTERS, clock=VirtualClock())
+    with pytest.raises(ValueError, match="drive must be"):
+        TransportServer(server, drive="psychic")
+
+
+# --------------------------------------------------------- router: DRR
+
+def _doc(tenant="default", op="cipher"):
+    return {"op": op, "tenant": tenant, "payload": {}}
+
+
+def test_drr_noisy_tenant_cannot_starve_quiet():
+    router = Router(clock=VirtualClock())
+    router.register_replica(0, capacity=1)
+    for _ in range(40):
+        assert router.submit(_doc("noisy")) is not None
+    quiet = [router.submit(_doc("quiet")) for _ in range(4)]
+    assert all(q is not None for q in quiet)
+
+    order = []
+    while True:
+        picked = router.next_assignment()
+        if picked is None:
+            break
+        ticket, rank = picked
+        order.append(ticket.tenant)
+        router.complete(ticket, rank)
+    assert len(order) == 44
+    # DRR interleaves every round: all 4 quiet dispatches land within the
+    # first handful of picks despite 40 noisy requests queued ahead
+    quiet_positions = [i for i, t in enumerate(order) if t == "quiet"]
+    assert len(quiet_positions) == 4
+    assert max(quiet_positions) < 10
+    ev = trace.events("request-routed")
+    assert len(ev) == 44 and all(e["replica"] == 0 for e in ev)
+
+
+def test_drr_weights_bias_dispatch_share():
+    # weight 0.5 earns a dispatch credit every *other* visit, so the
+    # best-effort tenant gets half the gold tenant's share while both
+    # backlogs stay non-empty
+    router = Router(clock=VirtualClock(), weights={"best-effort": 0.5})
+    router.register_replica(0, capacity=1)
+    for _ in range(30):
+        router.submit(_doc("gold"))
+        router.submit(_doc("best-effort"))
+    order = []
+    for _ in range(18):
+        ticket, rank = router.next_assignment()
+        order.append(ticket.tenant)
+        router.complete(ticket, rank)
+    assert order.count("gold") == 2 * order.count("best-effort")
+
+
+def test_router_sheds_when_backlog_full():
+    router = Router(clock=VirtualClock(), capacity=2)
+    assert router.submit(_doc()) is not None
+    assert router.submit(_doc()) is not None
+    assert router.submit(_doc()) is None
+    assert metrics.counter("fleet.shed.queue-full").value == 1
+    assert router.backlog() == 2
+
+
+# ----------------------------------------------- router: breakers + loss
+
+def test_breaker_opens_and_routes_around_bad_replica():
+    clock = VirtualClock()
+    router = Router(clock=clock, breaker_threshold=2, breaker_cooldown_s=5.0)
+    router.register_replica(0, capacity=4)
+    router.register_replica(1, capacity=4)
+    router.submit(_doc())
+
+    # rank 0 wins ties; fail it at the socket twice -> breaker opens
+    for _ in range(2):
+        ticket, rank = router.next_assignment()
+        assert rank == 0
+        router.fail_transport(ticket, rank)
+    assert router.state()["replicas"]["r0"]["breaker"] == "open"
+    ticket, rank = router.next_assignment()
+    assert rank == 1                       # routed around the open breaker
+    assert ticket.requeues == 2
+    router.complete(ticket, rank)
+    assert router.total_requeues == 2 and router.requeues[0] == 2
+
+    # cooldown elapses: the half-open probe readmits rank 0
+    clock.advance(6.0)
+    router.submit(_doc())
+    _, rank = router.next_assignment()
+    assert rank == 0
+
+
+def test_mark_down_requeues_inflight_at_front_zero_loss():
+    router = Router(clock=VirtualClock())
+    router.register_replica(0, capacity=4)
+    t_old = router.submit(_doc("a"))
+    t_new = router.submit(_doc("a"))
+    assigned = [router.next_assignment() for _ in range(2)]
+    assert all(a is not None and a[1] == 0 for a in assigned)
+    assert router.inflight() == 2
+
+    lost = router.mark_down(0, reason="sigkill")
+    assert {t.seq for t in lost} == {t_old.seq, t_new.seq}
+    assert router.inflight() == 0 and router.backlog() == 2
+    ev = trace.events("request-requeued")
+    assert len(ev) == 2 and all(e["from_replica"] == 0 for e in ev)
+    # a completion racing the death is recognized as stale
+    assert router.complete(t_old, 0) is False
+
+    router.register_replica(1, capacity=4)
+    redone = [router.next_assignment() for _ in range(2)]
+    assert {a[0].seq for a in redone} == {t_old.seq, t_new.seq}
+    assert all(a[1] == 1 for a in redone)
+    assert all(a[0].requeues == 1 for a in redone)
+
+
+# ------------------------------------------------- autoscaler hysteresis
+
+def _scaler(clock, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 2)
+    kw.setdefault("burn_sustain_s", 3.0)
+    kw.setdefault("ok_sustain_s", 6.0)
+    kw.setdefault("cooldown_s", 10.0)
+    return Autoscaler(clock=clock, **kw)
+
+
+def test_autoscaler_burn_must_sustain_before_scale_up():
+    clock = VirtualClock()
+    a = _scaler(clock)
+    assert a.evaluate(True, 0.9, 1) is None       # burn just started
+    clock.advance(2.0)
+    assert a.evaluate(True, 0.9, 1) is None       # 2s < burn_sustain_s
+    clock.advance(1.5)
+    assert a.evaluate(True, 0.9, 1) == "up"       # sustained
+    ev = trace.events("scale-up")
+    assert ev[-1]["replicas"] == 2 and ev[-1]["reason"] == "slo-burn"
+    # the burn window restarts after an action: still burning right
+    # after the scale-up is not an immediate second action
+    clock.advance(4.0)
+    assert a.evaluate(True, 0.9, 2) is None
+
+
+def test_autoscaler_scale_down_needs_health_idle_and_cooldown():
+    clock = VirtualClock()
+    a = _scaler(clock)
+    assert a.evaluate(True, 0.9, 1) is None       # burn starts at t=0
+    clock.advance(3.0)
+    assert a.evaluate(True, 0.9, 1) == "up"       # action at t=3
+    assert a.evaluate(False, 0.1, 2) is None      # ok timer starts (t=3)
+    clock.advance(6.0)                            # t=9: ok sustained, but
+    assert a.evaluate(False, 0.1, 2) is None      # cooldown (9-3 < 10)
+    clock.advance(4.5)                            # t=13.5: cooled
+    assert a.evaluate(False, 0.1, 2) == "down"
+    ev = trace.events("scale-down")
+    assert ev[-1]["replicas"] == 1 and ev[-1]["reason"] == "slo-ok"
+    # at the floor, sustained health never shrinks below min_replicas
+    clock.advance(20.0)
+    assert a.evaluate(False, 0.0, 1) is None
+    clock.advance(20.0)
+    assert a.evaluate(False, 0.0, 1) is None
+
+
+def test_autoscaler_busy_fleet_resets_the_idle_timer():
+    clock = VirtualClock()
+    a = _scaler(clock)
+    assert a.evaluate(False, 0.1, 2) is None      # idle timer starts
+    clock.advance(5.0)
+    assert a.evaluate(False, 0.8, 2) is None      # busy: timer reset
+    clock.advance(5.0)
+    assert a.evaluate(False, 0.1, 2) is None      # restarted, not sustained
+    clock.advance(6.0)
+    assert a.evaluate(False, 0.1, 2) == "down"
+
+
+def test_autoscaler_is_deterministic_under_virtual_clock():
+    script = [(True, 0.9, 1), (True, 0.9, 1), (True, 0.9, 1),
+              (False, 0.2, 2), (False, 0.2, 2), (False, 0.2, 2),
+              (False, 0.2, 2), (False, 0.2, 2)]
+
+    def run():
+        clock = VirtualClock()
+        a = _scaler(clock)
+        out = []
+        for burning, occ, n in script:
+            out.append(a.evaluate(burning, occ, n))
+            clock.advance(2.0)
+        return out
+
+    first, second = run(), run()
+    assert first == second
+    assert "up" in first and "down" in first
+
+
+# ----------------------------------------------------- fault grammar
+
+def test_replica_kill_clause_parses_and_misses_other_ranks():
+    plan = faults.FaultPlan.parse("replica-kill:1:3")
+    (clause,) = plan.clauses
+    assert clause.kind == "replica-kill" and clause.op == "1"
+    assert clause.nth == 3
+    with faults.injected("replica-kill:7"):
+        faults.maybe_kill_replica()   # rank mismatch: must be a no-op
+    with pytest.raises(faults.FaultSpecError):
+        faults.FaultPlan.parse("replica-kill")
+
+
+# --------------------------------------------------- e2e fleet kill arc
+
+@pytest.mark.slow
+def test_fleet_survives_replica_kill_with_zero_loss(monkeypatch):
+    """Two worker processes, SIGKILL one mid-batch: every accepted
+    request is still served, requeued results are bitwise-equal to a
+    serial solve, and the dead replica relaunches at incarnation 1.
+    The tier-1 fleet gate runs this same arc through the CLI."""
+    from cme213_tpu.serve.fleet import Fleet
+
+    monkeypatch.setenv("CME213_FAULTS", "replica-kill:1:1")
+    fleet = Fleet(replicas=2, mix="cipher", warm_requests=2,
+                  max_batch=4).start()
+    try:
+        specs = build_mix("cipher", 24, seed=21, tenants=2)
+        results = [None] * len(specs)
+
+        def client(i, spec):
+            with TransportClient(fleet.addr) as c:
+                results[i] = c.solve(spec.op, spec.payload,
+                                     tenant=spec.tenant)
+
+        threads = [threading.Thread(target=client, args=(i, s), daemon=True)
+                   for i, s in enumerate(specs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert all(r is not None for r in results)
+        assert all(r.status == OK for r in results)
+        refs = _serve_serial(specs)
+        for res, ref in zip(results, refs):
+            assert _bits(res.value) == _bits(ref.value)
+        # the relaunch races the last response: wait for incarnation 1
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            stats = fleet.stats()
+            r1 = stats["replicas"].get("r1", {})
+            if r1.get("incarnation") == 1 and r1.get("up"):
+                break
+            time.sleep(0.25)
+    finally:
+        fleet.close()
+    assert stats["requeues"] >= 1
+    assert stats["replicas"]["r1"]["incarnation"] == 1
+    assert stats["replicas"]["r1"]["up"] is True
+    assert trace.events("request-requeued")
+    served_by = {e["replica"] for e in trace.events("request-routed")}
+    assert served_by == {0, 1}
