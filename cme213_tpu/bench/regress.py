@@ -49,7 +49,7 @@ import sys
 #: metric columns where bigger is better
 HIGHER_BETTER = {"gbs", "gflops", "h2d_gbs", "d2h_gbs", "char_gbs",
                  "uint_gbs", "uint2_gbs", "achieved_gbs",
-                 "radix_elems_per_s", "pct_peak"}
+                 "radix_elems_per_s", "pct_peak", "mbs", "req_s"}
 #: metric columns where smaller is better
 LOWER_BETTER = {"ms", "seconds", "merge_s", "cpu_ms"}
 #: columns that are neither identity nor comparable signal.  ``bytes``

@@ -146,6 +146,12 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     # request lifecycle (serve/server.py): one per served/failed request,
     # linking the request id to the batch span that executed it
     "request-served": ("rid", "op", "tenant", "batch", "status", "total_ms"),
+    # wire codec span tags (serve/transport.py): one per encode/decode
+    # on either side of a v2 frame, sampled past the first 64 rids of a
+    # connection — the serve.request.{encode,decode}_ms histograms see
+    # the full population
+    "request-serialized": ("rid", "op", "ms", "nbytes"),
+    "request-deserialized": ("rid", "op", "ms", "nbytes"),
     # SLO burn-rate monitor (serve/slo.py)
     "slo-burn": ("objective", "burn_short", "burn_long", "threshold"),
     "slo-ok": ("objective", "burn_short"),

@@ -14,21 +14,31 @@ replica** while the rest keep serving.
 
 Topology::
 
-    clients ── length-prefixed JSON frames ──> FleetFrontEnd (this proc)
+    clients ── v2 binary frames (or legacy JSON) ──> FleetFrontEnd (this proc)
                                                 │  Router (tenant-fair DRR,
                                                 │   per-replica breakers,
                                                 │   in-flight ledger)
-                                     dispatcher │ + per-replica sender threads
+                                     dispatcher │ + one pipelined channel
+                                                │   per replica (shm lane
+                                                │   when negotiable)
                  ┌──────────────────────────────┼──────────────┐
             replica 0 (proc)               replica 1       ... replica N-1
             Server + TransportServer       (each: warmed program cache,
             (drive="thread", kill_guard)    heartbeats, per-rank sinks)
 
+Dispatch is **pipelined**: each replica is fed over ONE persistent v2
+connection carrying up to ``dispatch_width`` requests in flight, keyed
+by request id (``serve/wire.py``); payload sections pass through the
+front end without re-encoding, and — being same-host — the channel
+negotiates the shared-memory lane (``serve/shm.py``) so large payloads
+skip the loopback socket entirely.
+
 **Zero accepted-request loss.**  The front end owns every accepted
 request until a response exists: a ticket is held in the router's
-in-flight ledger while a sender forwards it, and a replica death — seen
-as a socket error by the sender *and* as a process exit by the
-supervisor — requeues the ticket (``request-requeued``) for a healthy
+in-flight ledger while a channel forwards it, and a replica death — seen
+as a connection error by the channel (which fails *all* of its in-flight
+tickets at once, however deep the pipeline) *and* as a process exit by
+the supervisor — requeues the ticket (``request-requeued``) for a healthy
 replica.  The dead replica's flight-recorder dump (it dumps before the
 injected SIGKILL; see ``faults.maybe_kill_replica``) is read back for
 the post-mortem, confirming which requests were mid-batch.  Solves are
@@ -65,6 +75,7 @@ from ..dist.launch import (
     free_port,
 )
 from ..dist.supervisor import HEARTBEAT_DIR_ENV, HEARTBEAT_INTERVAL_ENV
+from . import wire
 from .request import FAILED, QUEUE_FULL, SHED
 from .router import Autoscaler, Router, Ticket
 from .transport import (
@@ -96,6 +107,85 @@ class ReplicaProc:
         return f"127.0.0.1:{self.port}"
 
 
+class ReplicaChannel:
+    """One pipelined v2 connection from the front tier to a replica.
+
+    Tickets go out with :meth:`send` (non-blocking past the socket
+    write) and complete on the transport client's receiver thread via
+    ``_on_response`` — many in flight at once, matched by request id.
+    When the connection dies, ``_on_error`` fails **every** in-flight
+    ticket back to the router in one sweep: a SIGKILL with a full
+    pipeline requeues the whole window through the ledger, losing
+    nothing.  Being same-host, the channel asks for the shared-memory
+    lane and falls back to the socket when the server declines.
+    """
+
+    def __init__(self, fleet: "Fleet", rank: int, addr: str,
+                 shm: bool = True, connect_timeout_s: float = 2.0):
+        self.fleet = fleet
+        self.rank = rank
+        self._mu = threading.Lock()
+        self._inflight: dict[int, Ticket] = {}
+        self._closing = False
+        self.dead = False
+        self.client = TransportClient(
+            addr, connect_timeout_s=connect_timeout_s, shm=shm,
+            on_response=self._on_response, on_error=self._on_error)
+
+    def send(self, ticket: Ticket) -> None:
+        """Pipeline one ticket; raises on a dead connection (the caller
+        requeues via the router)."""
+        rid = self.client.next_rid()
+        with self._mu:
+            if self.dead:
+                raise ConnectionError(f"channel to replica {self.rank} down")
+            self._inflight[rid] = ticket
+        try:
+            self.client.submit_doc(ticket.doc, ticket.sections, rid=rid)
+        except Exception:
+            with self._mu:
+                self._inflight.pop(rid, None)
+            raise
+
+    def inflight(self) -> int:
+        with self._mu:
+            return len(self._inflight)
+
+    # -- receiver-thread callbacks
+
+    def _on_response(self, rid: int, meta: dict, sections: list) -> None:
+        with self._mu:
+            ticket = self._inflight.pop(rid, None)
+        if ticket is None:
+            return
+        meta.setdefault("replica", self.rank)
+        fleet = self.fleet
+        with fleet._cv:
+            fleet.router.complete(ticket, self.rank)
+            fleet._cv.notify_all()
+        fleet._observe(meta)
+        fleet._deliver(ticket, meta, sections)
+
+    def _on_error(self, exc: Exception) -> None:
+        with self._mu:
+            if self._closing:
+                return
+            self.dead = True
+            pending = list(self._inflight.values())
+            self._inflight.clear()
+        fleet = self.fleet
+        with fleet._cv:
+            for ticket in pending:
+                fleet.router.fail_transport(ticket, self.rank)
+            fleet._cv.notify_all()
+
+    def close(self) -> None:
+        with self._mu:
+            self._closing = True
+            self.dead = True
+        self.client.close()
+
+
 class Fleet:
     """Spawn, supervise, scale, and route over N replica processes."""
 
@@ -107,13 +197,17 @@ class Fleet:
                  max_restarts: int = 4,
                  slo=None, autoscaler: Autoscaler | None = None,
                  clock: Clock | None = None,
-                 router: Router | None = None):
+                 router: Router | None = None, shm: bool = True):
         self.initial_replicas = replicas
         self.capacity = capacity
         self.max_batch = max_batch
         self.mix = mix
         self.warm_requests = warm_requests
+        # pipeline depth: max requests in flight on a replica's channel
+        # (PR 15 ran this many blocking sender threads per replica; now
+        # it is the router's per-replica capacity on ONE connection)
         self.dispatch_width = dispatch_width or max_batch
+        self.shm = shm
         self.ready_timeout_s = ready_timeout_s
         self.max_restarts = max_restarts
         self.slo = slo
@@ -193,13 +287,11 @@ class Fleet:
             self._procs[rank] = rep
             if rank not in self._send_queues:
                 self._send_queues[rank] = queue_mod.Queue()
-                self._sender_threads[rank] = []
-                for i in range(self.dispatch_width):
-                    t = threading.Thread(
-                        target=self._sender_loop, args=(rank,),
-                        name=f"fleet-send-r{rank}.{i}", daemon=True)
-                    t.start()
-                    self._sender_threads[rank].append(t)
+                t = threading.Thread(
+                    target=self._sender_loop, args=(rank,),
+                    name=f"fleet-send-r{rank}", daemon=True)
+                t.start()
+                self._sender_threads[rank] = [t]
 
     def _poll_starting(self) -> None:
         """Probe starting replicas; register the ones that answer ping."""
@@ -240,7 +332,10 @@ class Fleet:
             self._send_queues[rank].put(ticket)
 
     def _sender_loop(self, rank: int) -> None:
-        client: TransportClient | None = None
+        """Feed one replica over one pipelined channel.  The loop only
+        *sends*; completions (and connection-death requeues) arrive on
+        the channel's receiver thread."""
+        channel: ReplicaChannel | None = None
         connected_port = None
         q = self._send_queues[rank]
         while not self._stop.is_set():
@@ -257,33 +352,40 @@ class Fleet:
             try:
                 if addr is None:
                     raise ConnectionError(f"replica {rank} gone")
-                if client is None or connected_port != port:
-                    if client is not None:
-                        client.close()
-                    client = TransportClient(addr, connect_timeout_s=2.0)
+                if channel is None or channel.dead or connected_port != port:
+                    if channel is not None:
+                        channel.close()
+                    channel = ReplicaChannel(self, rank, addr,
+                                             shm=self.shm)
                     connected_port = port
-                resp = client.request(ticket.doc)
+                channel.send(ticket)
             except (OSError, ConnectionError, ValueError):
-                if client is not None:
-                    client.close()
-                client = None
+                if channel is not None:
+                    channel.close()
+                channel = None
                 with self._cv:
                     self.router.fail_transport(ticket, rank)
                     self._cv.notify_all()
-                continue
-            resp.setdefault("replica", rank)
-            with self._cv:
-                self.router.complete(ticket, rank)
-                self._cv.notify_all()
-            self._observe(resp)
-            self._deliver(ticket, resp)
-        if client is not None:
-            client.close()
+        if channel is not None:
+            channel.close()
 
-    @staticmethod
-    def _deliver(ticket: Ticket, resp: dict) -> None:
+    def _deliver(self, ticket: Ticket, meta: dict,
+                 sections: list = ()) -> None:
+        """Answer the client that owns the ticket: v2 clients get the
+        sections forwarded as-is on their pipelined connection; v1
+        clients get a self-describing JSON doc (sections inlined to
+        base64) and their parked connection thread woken."""
+        reply = ticket.reply
+        if reply is not None:
+            conn, wire_rid = reply
+            try:
+                conn.send_v2(wire.FT_RESPONSE, wire_rid, meta, sections)
+            except (ConnectionError, OSError):
+                pass                 # client went away; result dropped
+            return
         if ticket.done is not None and not ticket.done.is_set():
-            ticket.result = resp
+            ticket.result = (wire.inline_sections(meta, list(sections))
+                             if sections else meta)
             ticket.done.set()
 
     def _observe(self, resp: dict) -> None:
@@ -442,13 +544,32 @@ class Fleet:
 
 
 class _FleetFrontEnd(FrameServer):
-    """The fleet's client-facing socket: accepts frames concurrently,
-    parks each connection thread on its ticket until a replica's
-    response arrives (possibly after a requeue)."""
+    """The fleet's client-facing socket.  v2 connections pipeline:
+    each accepted frame becomes a ticket carrying its binary sections
+    and its reply handle, and the reader moves straight to the next
+    frame — responses flow back whenever a replica answers.  v1
+    connections keep the legacy contract: the connection thread parks
+    on its ticket until the response arrives (possibly after a
+    requeue)."""
 
     def __init__(self, fleet: Fleet, host: str, port: int):
         super().__init__(host, port)
         self.fleet = fleet
+
+    def handle_v2(self, conn, rid: int, meta: dict, sections: list,
+                  read_s: float = 0.0) -> None:
+        fleet = self.fleet
+        with fleet._cv:
+            ticket = fleet.router.submit(meta)
+            if ticket is not None:
+                ticket.sections = sections    # pass through, no re-encode
+                ticket.reply = (conn, rid)
+                fleet._cv.notify_all()
+        if ticket is None:
+            conn.send_v2(wire.FT_RESPONSE, rid,
+                         {"rid": -1, "op": meta.get("op"), "status": SHED,
+                          "reason": QUEUE_FULL,
+                          "tenant": meta.get("tenant", "default")})
 
     def handle(self, doc: dict) -> dict:
         with self.fleet._cv:

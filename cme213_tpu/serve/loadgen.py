@@ -40,13 +40,16 @@ from . import slo as slo_mod
 from .request import OK, SHED, FAILED, PHASES, RequestSpec
 from .server import Server
 
-#: ops the ``--mix`` flag accepts, comma-separated
-MIX_OPS = ("spmv", "heat", "cipher")
+#: ops the ``--mix`` flag accepts, comma-separated.  ``stub`` is the
+#: transport-measurement op: the adapter echoes the payload with no jax
+#: on the path, so a closed-loop run over it measures the wire + queue
+#: cost alone (the tier-1 >= 10k req/s gate drives this mix).
+MIX_OPS = ("spmv", "heat", "cipher", "stub")
 
 
 def build_mix(mix: str, requests: int, seed: int = 0,
               deadline_ms: float | None = None,
-              tenants: int = 1) -> list[RequestSpec]:
+              tenants: int = 1, stub_bytes: int = 1024) -> list[RequestSpec]:
     """The synthetic request population: ``requests`` specs cycling
     through the ops named in ``mix``, shapes chosen so that same-op
     requests recur in a handful of shape classes (batching has something
@@ -70,6 +73,13 @@ def build_mix(mix: str, requests: int, seed: int = 0,
                                     iters=6, seed=seed + i)
             specs.append(RequestSpec("spmv_scan", prob,
                                      deadline_ms=deadline_ms, tenant=tenant))
+        elif op == "stub":
+            # one shape class on purpose: every request batches with its
+            # neighbours and the measured cost is pure transport + queue
+            specs.append(RequestSpec(
+                "stub", rng.integers(0, 255, size=stub_bytes)
+                .astype(np.uint8),
+                deadline_ms=deadline_ms, tenant=tenant))
         elif op == "heat":
             from ..config import SimParams
 
@@ -131,11 +141,14 @@ def run_load(server: Server, specs: list[RequestSpec],
 def run_load_transport(addr: str, specs: list[RequestSpec],
                        mode: str = "closed", concurrency: int = 8,
                        burst: int = 16,
-                       burst_interval_s: float = 0.005) -> dict:
+                       burst_interval_s: float = 0.005,
+                       pipeline: int = 1) -> dict:
     """Drive a socket front end (``serve/transport.py`` — one server or
     a whole fleet) with **real concurrent client threads**, which the
     in-process :func:`run_load` cannot do.  Closed keeps ``concurrency``
-    connections each with one request in flight; open fires every
+    connections each with ``pipeline`` requests in flight (the v2
+    submit/result window — ``pipeline=1`` degenerates to the blocking
+    solve loop, which also covers v1 servers); open fires every
     request in its own thread, ``burst`` at a time, arrivals ignoring
     completions — genuine concurrent pressure on the accept path."""
     import threading
@@ -155,26 +168,79 @@ def run_load_transport(addr: str, specs: list[RequestSpec],
     if mode == "closed":
         remaining = list(specs)
 
+        def _take(k: int) -> list[RequestSpec]:
+            with mu:
+                out, remaining[:k] = remaining[:k], []
+                return out
+
         def worker() -> None:
             client = None
-            while True:
+            window: list[tuple[int, RequestSpec]] = []  # (rid, spec) FIFO
+            batch: list[RequestSpec] = []               # taken, not sent
+
+            def settle_many(rs: list) -> None:
                 with mu:
-                    if not remaining:
-                        break
-                    spec = remaining.pop(0)
+                    results.extend(rs)
+
+            while True:
                 try:
+                    if not batch and not window:
+                        batch = _take(max(1, pipeline))
+                        if not batch:
+                            break
                     if client is None:
-                        client = TransportClient(addr)
-                    res = client.solve(spec.op, spec.payload,
-                                       deadline_ms=spec.deadline_ms,
-                                       tenant=spec.tenant)
-                except (OSError, ConnectionError, ValueError) as e:
+                        # sync pipelined mode: this worker is the only
+                        # caller, so it parses responses itself instead
+                        # of paying a receiver-thread handoff per request
+                        client = TransportClient(addr, recv_thread=False)
+                    if client.proto != 2 or pipeline <= 1:
+                        # stop-and-wait (the only v1 option)
+                        spec = batch.pop(0)
+                        settle_many([client.solve(
+                            spec.op, spec.payload,
+                            deadline_ms=spec.deadline_ms,
+                            tenant=spec.tenant)])
+                        continue
+                    # sliding window: fill to depth (submits corked,
+                    # one vectored write for the whole refill), then
+                    # retire the oldest half — ``pipeline`` requests
+                    # ride one connection and the syscall + lock count
+                    # is ~2/chunk, not 2/request
+                    while len(window) < pipeline:
+                        if not batch:
+                            batch = _take(pipeline - len(window))
+                            if not batch:
+                                break
+                        spec = batch.pop(0)
+                        window.append((client.submit(
+                            spec.op, spec.payload,
+                            deadline_ms=spec.deadline_ms,
+                            tenant=spec.tenant, flush=False), spec))
+                    client.flush()
+                    done = []
+                    for _ in range(min(len(window),
+                                       max(1, pipeline // 2))):
+                        rid, _ = window[0]
+                        done.append(client.result(rid))
+                        window.pop(0)
+                    settle_many(done)
+                except (OSError, ConnectionError, ValueError,
+                        TimeoutError, KeyError) as e:
                     if client is not None:
                         client.close()
-                    client = None
-                    res = _failed(spec, e)
-                with mu:
-                    results.append(res)
+                        client = None
+                    # everything on the dead connection fails, plus one
+                    # unsent spec so a dead server can't spin this loop;
+                    # the rest of the unsent batch goes back in the pool
+                    dead = [_failed(lost, e) for _, lost in window]
+                    window = []
+                    if batch:
+                        dead.append(_failed(batch.pop(0), e))
+                        if batch:
+                            with mu:
+                                remaining[:0] = batch
+                            batch = []
+                    settle_many(dead)
             if client is not None:
                 client.close()
 
@@ -197,17 +263,28 @@ def run_load_transport(addr: str, specs: list[RequestSpec],
     else:
         raise ValueError(f"unknown mode {mode!r} (closed | open)")
 
-    if mode == "open":
-        # arrivals ignore completions: launch in bursts, never wait
-        for i, t in enumerate(threads):
-            t.start()
-            if burst and (i + 1) % burst == 0:
-                time_mod.sleep(burst_interval_s)
-    else:
+    # gc pauses inside the drive window read as multi-ms latency spikes
+    # that have nothing to do with the transport under test; collect
+    # once up front, then hold gc off until the window closes
+    import gc
+    gc.collect()
+    gc_was_on = gc.isenabled()
+    gc.disable()
+    try:
+        if mode == "open":
+            # arrivals ignore completions: launch in bursts, never wait
+            for i, t in enumerate(threads):
+                t.start()
+                if burst and (i + 1) % burst == 0:
+                    time_mod.sleep(burst_interval_s)
+        else:
+            for t in threads:
+                t.start()
         for t in threads:
-            t.start()
-    for t in threads:
-        t.join()
+            t.join()
+    finally:
+        if gc_was_on:
+            gc.enable()
     return {"results": results, "elapsed_s": time_mod.monotonic() - t0}
 
 
@@ -229,6 +306,59 @@ def fleet_section(run: dict, addr: str) -> dict:
                 "occupancy", "backlog", "replicas", "flight_confirmed"):
         if key in stats:
             section[key] = stats[key]
+    return section
+
+
+def transport_section(run: dict, before: dict, after: dict) -> dict:
+    """The SLO report's ``transport`` subsection: where a wire request's
+    milliseconds actually went.  Client-side attribution rides each
+    result (``res.client`` — encode/decode ms and the submit→response
+    RTT measured at the socket); server-side codec cost comes from the
+    ``serve.request.encode_ms``/``decode_ms`` histograms the transport
+    layer feeds (the same numbers ``trace summary`` renders).  The
+    honest-measurement gate reads ``codec_share``: the p99 of per-request
+    client encode+decode as a fraction of the p99 RTT — transport framing
+    is an overhead and must price like one."""
+    infos = [r.client for r in run["results"]
+             if getattr(r, "client", None)]
+    enc = [i["encode_ms"] for i in infos if "encode_ms" in i]
+    dec = [i["decode_ms"] for i in infos if "decode_ms" in i]
+    rtt = [i["rtt_ms"] for i in infos if "rtt_ms" in i]
+    codec = [i.get("encode_ms", 0.0) + i.get("decode_ms", 0.0)
+             for i in infos]
+    # wire + queue time: RTT minus the server's own request clock (the
+    # timing breakdown every served result carries)
+    overhead = [r.client["rtt_ms"] - r.timing["total_ms"]
+                for r in run["results"]
+                if getattr(r, "client", None)
+                and "rtt_ms" in r.client
+                and r.timing and r.timing.get("total_ms") is not None]
+
+    d = metrics.delta(before, after)
+    bh, ah = before.get("histograms", {}), after.get("histograms", {})
+
+    def hist_delta(name: str) -> dict | None:
+        h, p = ah.get(name), bh.get(name) or {}
+        if not h:
+            return None
+        n = int(h.get("count", 0)) - int(p.get("count", 0))
+        if n <= 0:
+            return None
+        s = float(h.get("sum") or 0.0) - float(p.get("sum") or 0.0)
+        return {"count": n, "mean": round(s / n, 4)}
+
+    section = {
+        "client": {"encode_ms": _pcts(enc), "decode_ms": _pcts(dec),
+                   "rtt_ms": _pcts(rtt)},
+        "server": {"encode_ms": hist_delta("serve.request.encode_ms"),
+                   "decode_ms": hist_delta("serve.request.decode_ms")},
+        "transport_ms": _pcts(overhead),
+        "proto_v1_frames": d["counters"].get("transport.proto_v1", 0),
+    }
+    codec_p = _pcts(codec)
+    rtt_p = _pcts(rtt)
+    if codec_p and rtt_p and rtt_p["p99"]:
+        section["codec_share"] = round(codec_p["p99"] / rtt_p["p99"], 4)
     return section
 
 
@@ -471,6 +601,29 @@ def format_report(report: dict) -> str:
             f"{num['sentinel_trips']} sentinel trip(s)")
         for key in num.get("demoted") or []:
             lines.append(f"  DEMOTED {key}")
+    tp = report.get("transport")
+    if tp:
+        lines.append("transport (p50/p99 ms):")
+        cl = tp.get("client") or {}
+        cells = "  ".join(
+            f"{k.replace('_ms', '')} {cl[k]['p50']}/{cl[k]['p99']}"
+            for k in ("encode_ms", "decode_ms", "rtt_ms") if cl.get(k))
+        if cells:
+            lines.append(f"  client: {cells}")
+        sv = tp.get("server") or {}
+        cells = "  ".join(
+            f"{k.replace('_ms', '')} mean {sv[k]['mean']} x{sv[k]['count']}"
+            for k in ("encode_ms", "decode_ms") if sv.get(k))
+        if cells:
+            lines.append(f"  server: {cells}")
+        if tp.get("transport_ms"):
+            t = tp["transport_ms"]
+            lines.append(f"  wire+queue: {t['p50']}/{t['p99']}")
+        if tp.get("codec_share") is not None:
+            lines.append(f"  codec share of p99 rtt: "
+                         f"{tp['codec_share']:.2%}")
+        if tp.get("proto_v1_frames"):
+            lines.append(f"  legacy v1 frames: {tp['proto_v1_frames']}")
     fleet = report.get("fleet")
     if fleet:
         seen = ", ".join(fleet.get("replicas_seen") or []) or "-"
@@ -548,25 +701,84 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--transport", default=None, metavar="HOST:PORT",
                     help="drive a socket front end (serve/transport.py or "
                     "a fleet) with real concurrent client threads instead "
-                    "of an in-process server; the report gains a fleet "
-                    "section")
+                    "of an in-process server; the report gains fleet and "
+                    "transport sections.  'self' spins up an in-process "
+                    "TransportServer for the run (the CI rate gate)")
+    ap.add_argument("--pipeline", type=int, default=1,
+                    help="requests in flight per connection in closed "
+                    "--transport mode (v2 submit/result window; 1 = "
+                    "blocking solve per request)")
+    ap.add_argument("--stub-bytes", type=int, default=1024,
+                    help="payload size for the 'stub' mix op")
+    ap.add_argument("--stub-solve", action="store_true",
+                    help="with --transport self: serve from a "
+                    "StubSolveServer (decode-echo-encode inline, no "
+                    "queue/batcher) so the run measures the transport "
+                    "alone")
+    ap.add_argument("--min-rps", type=float, default=None,
+                    help="exit nonzero when served throughput falls below "
+                    "this (the transport rate gate: --transport self "
+                    "--mix stub measures the wire+queue path alone)")
+    ap.add_argument("--max-codec-share", type=float, default=None,
+                    help="exit nonzero when client encode+decode p99 "
+                    "exceeds this fraction of the p99 rtt (the framing-"
+                    "overhead gate; needs --transport)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
     flight.install()   # a crashing load run leaves its black box behind
     specs = build_mix(args.mix, args.requests, seed=args.seed,
-                      deadline_ms=args.deadline_ms, tenants=args.tenants)
+                      deadline_ms=args.deadline_ms, tenants=args.tenants,
+                      stub_bytes=args.stub_bytes)
 
     if args.transport:
-        before = metrics.snapshot()
-        run = run_load_transport(args.transport, specs, mode=args.mode,
-                                 concurrency=args.concurrency,
-                                 burst=args.burst)
-        report = slo_report(run, before, metrics.snapshot())
-        report["fleet"] = fleet_section(run, args.transport)
+        from .transport import StubSolveServer, TransportServer
+
+        own_server = None
+        addr = args.transport
+        if addr == "self":
+            own_server = (StubSolveServer() if args.stub_solve
+                          else TransportServer(
+                              Server(capacity=args.capacity,
+                                     max_batch=args.max_batch,
+                                     clock=Clock()),
+                              drive="thread",
+                              poll_interval_s=0.001)).start()
+            addr = own_server.addr
+        try:
+            if args.warm:
+                run_load_transport(addr, specs, mode=args.mode,
+                                   concurrency=args.concurrency,
+                                   burst=args.burst,
+                                   pipeline=args.pipeline)
+            before = metrics.snapshot()
+            run = run_load_transport(addr, specs, mode=args.mode,
+                                     concurrency=args.concurrency,
+                                     burst=args.burst,
+                                     pipeline=args.pipeline)
+            after = metrics.snapshot()
+            report = slo_report(run, before, after)
+            report["transport"] = transport_section(run, before, after)
+            report["fleet"] = fleet_section(run, addr)
+        finally:
+            if own_server is not None:
+                own_server.close()
         print(json.dumps(report, indent=2) if args.as_json
               else format_report(report))
-        return 0
+        rc = 0
+        rps = report["throughput_rps"]
+        if args.min_rps is not None and (rps or 0) < args.min_rps:
+            print(f"FAIL: {rps} req/s below --min-rps={args.min_rps}",
+                  file=sys.stderr)
+            rc = 1
+        share = report["transport"].get("codec_share")
+        if args.max_codec_share is not None:
+            if share is None or share > args.max_codec_share:
+                print(f"FAIL: codec share {share} exceeds "
+                      f"--max-codec-share={args.max_codec_share}",
+                      file=sys.stderr)
+                rc = 1
+        return rc
 
     last_slo = None
 

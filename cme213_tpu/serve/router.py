@@ -69,6 +69,11 @@ class Ticket:
     requeues: int = 0
     done: object = None            # threading.Event, set by the fleet
     result: dict | None = None
+    # v2 transport extras: binary payload sections ride beside the doc
+    # (forwarded to the replica untouched), and a pipelined client's
+    # reply handle (connection, wire request id) replaces the Event
+    sections: list = field(default_factory=list)
+    reply: object = None
 
 
 @dataclass

@@ -216,5 +216,31 @@ class CipherAdapter:
         return None  # bytes in ≈ bytes out: admission adds nothing here
 
 
+class StubAdapter:
+    """``np.ndarray`` payloads echoed back untouched, no jax anywhere on
+    the path.  This is the transport's honest-measurement op: with the
+    solve stubbed out, a closed-loop loadgen run measures exactly what
+    the wire + queue + batcher cost per request (the tier-1 gate holds
+    this path to >= 10k req/s on CPU), and any device time would only
+    hide transport regressions."""
+
+    op = "stub"
+
+    def shape_class(self, arr: np.ndarray, coarse: bool = False) -> str:
+        return f"n{int(np.asarray(arr).size)}"
+
+    def rungs(self, degraded: bool = False) -> tuple[str, ...]:
+        return ("echo",)
+
+    def run_batch(self, payloads, rung: str, coarse: bool = False):
+        if rung != "echo":
+            raise ValueError(f"unknown stub rung {rung!r}")
+        return [np.asarray(p) for p in payloads]
+
+    def preflight_builder(self, payloads, rung: str, coarse: bool = False):
+        return None
+
+
 #: the default adapter registry — the hw workload mix as request types
-ADAPTERS = {a.op: a for a in (SpmvAdapter(), HeatAdapter(), CipherAdapter())}
+ADAPTERS = {a.op: a for a in (SpmvAdapter(), HeatAdapter(),
+                              CipherAdapter(), StubAdapter())}

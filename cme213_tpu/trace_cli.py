@@ -426,8 +426,17 @@ def summarize(events: list[dict], out=None) -> dict:
     degraded = sum(1 for e in events if e["event"] == "span-end"
                    and e.get("span") == "degraded-mode")
     reqs = [e for e in events if e["event"] == "request-served"]
+    # transport codec span tags (serve/transport.py samples these past
+    # the first 64 rids of a connection — counts here are of *traced*
+    # codec operations; the full population lives in the
+    # serve.request.{encode,decode}_ms histograms)
+    codec = {"encode": [e for e in events
+                        if e["event"] == "request-serialized"],
+             "decode": [e for e in events
+                        if e["event"] == "request-deserialized"]}
     serving = None
-    if shed or any(breaker.values()) or batches or reqs:
+    if shed or any(breaker.values()) or batches or reqs or any(
+            codec.values()):
         occ = [e["occupancy"] for e in batches
                if isinstance(e.get("occupancy"), (int, float))]
         sizes = [e["size"] for e in batches
@@ -451,6 +460,14 @@ def summarize(events: list[dict], out=None) -> dict:
             "batch_occupancy": (sum(occ) / len(occ)) if occ else None,
             "degraded_batches": degraded,
         }
+        for d, evs in codec.items():
+            ms = [e["ms"] for e in evs
+                  if isinstance(e.get("ms"), (int, float))]
+            nb = [e["nbytes"] for e in evs
+                  if isinstance(e.get("nbytes"), (int, float))]
+            serving[f"{d}_traced"] = len(evs)
+            serving[f"{d}_ms_mean"] = (sum(ms) / len(ms)) if ms else None
+            serving[f"{d}_bytes"] = sum(nb)
         w(f"serving: {len(batches)} batch(es)")
         if sizes:
             w(f", mean size {serving['batch_mean_size']:.2f}"
@@ -458,6 +475,11 @@ def summarize(events: list[dict], out=None) -> dict:
         if degraded:
             w(f", {degraded} degraded")
         w("\n")
+        for d in ("encode", "decode"):
+            if serving[f"{d}_traced"]:
+                w(f"  wire {d}: {serving[f'{d}_traced']} traced, "
+                  f"mean {serving[f'{d}_ms_mean']:.4f} ms, "
+                  f"{serving[f'{d}_bytes']} B\n")
         for key, n in serving["shed"].items():
             if n:
                 w(f"  shed {key} x{n}\n")

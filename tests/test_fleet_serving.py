@@ -413,6 +413,114 @@ def test_autoscaler_is_deterministic_under_virtual_clock():
     assert "up" in first and "down" in first
 
 
+# ------------------------------------------- pipelined channel faults
+
+class _MiniFleet:
+    """Just enough fleet for a ReplicaChannel: the router lock, the
+    completion hooks, and v1-style ticket delivery."""
+
+    def __init__(self, router):
+        self.router = router
+        self._cv = threading.Condition()
+
+    def _observe(self, meta):
+        pass
+
+    def _deliver(self, ticket, meta, sections=()):
+        from cme213_tpu.serve import wire
+        ticket.result = (wire.inline_sections(meta, list(sections))
+                         if sections else meta)
+        ticket.done.set()
+
+
+def _v2_tickets(router, specs):
+    from cme213_tpu.serve import wire
+    tickets = []
+    for spec in specs:
+        sw = wire.SectionWriter()
+        doc = {"op": spec.op,
+               "payload": wire.encode_payload(spec.op, spec.payload, sw),
+               "tenant": spec.tenant}
+        t = router.submit(doc)
+        assert t is not None
+        t.sections = sw.arrays
+        t.done = threading.Event()
+        tickets.append(t)
+    return tickets
+
+
+def test_sever_with_eight_in_flight_requeues_all_via_ledger():
+    """The pipelined-world replica-kill contract: ONE connection with 8
+    requests in flight dies mid-pipeline; the channel fails all 8 back
+    to the router's ledger (8 ``request-requeued``), and a healthy
+    replica then serves every one bitwise-equal — zero accepted-request
+    loss without a single request-level retry by the client."""
+    from cme213_tpu.serve.fleet import ReplicaChannel
+
+    router = Router(clock=VirtualClock())
+    router.register_replica(0, capacity=8)
+    fleet = _MiniFleet(router)
+    specs = build_mix("cipher", 8, seed=17, tenants=2)
+    tickets = _v2_tickets(router, specs)
+
+    # replica 0 accepts but never steps: the whole window stays in flight
+    server_a = Server(adapters=ADAPTERS, clock=VirtualClock(), max_batch=8)
+    ts_a = TransportServer(server_a, drive="caller").start()
+    chan = ReplicaChannel(fleet, 0, ts_a.addr, shm=False)
+    try:
+        sent = 0
+        while True:
+            a = router.next_assignment()
+            if a is None:
+                break
+            ticket, rank = a
+            assert rank == 0
+            chan.send(ticket)
+            sent += 1
+        assert sent == 8 and router.inflight() == 8
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(server_a.queue) < 8:
+            time.sleep(0.01)
+        assert len(server_a.queue) == 8     # all 8 pipelined on one conn
+
+        ts_a.close()                        # SIGKILL as seen from a socket
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and router.backlog() < 8:
+            time.sleep(0.01)
+        assert router.backlog() == 8 and router.inflight() == 0
+        assert router.total_requeues == 8
+        assert len(trace.events("request-requeued")) == 8
+        assert all(t.requeues == 1 for t in tickets)
+    finally:
+        chan.close()
+        ts_a.close()
+
+    # a healthy replica drains the requeued window: nothing was lost
+    router.mark_down(0, reason="severed")
+    router.register_replica(1, capacity=8)
+    server_b = Server(adapters=ADAPTERS, clock=VirtualClock(), max_batch=8)
+    ts_b = TransportServer(server_b, drive="thread").start()
+    chan_b = ReplicaChannel(fleet, 1, ts_b.addr, shm=False)
+    try:
+        while True:
+            a = router.next_assignment()
+            if a is None:
+                break
+            chan_b.send(a[0])
+        for t in tickets:
+            assert t.done.wait(30)
+        results = [decode_result(t.result) for t in tickets]
+        assert all(r.status == OK for r in results)
+        assert all(getattr(r, "replica", None) == 1 for r in results)
+        refs = _serve_serial(specs)
+        for res, ref in zip(results, refs):
+            assert _bits(res.value) == _bits(ref.value)
+        assert router.inflight() == 0 and router.backlog() == 0
+    finally:
+        chan_b.close()
+        ts_b.close()
+
+
 # ----------------------------------------------------- fault grammar
 
 def test_replica_kill_clause_parses_and_misses_other_ranks():

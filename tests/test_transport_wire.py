@@ -1,0 +1,295 @@
+"""v2 binary wire format: codec property tests and transport behavior.
+
+The satellite contract for the zero-copy framing (``serve/wire.py``):
+every (dtype x shape) combination — 0-d scalars, empty arrays,
+F-contiguous and strided views, explicit big-endian dtypes — must
+round-trip **bitwise** through the binary sections, length fields must
+be 8-byte (>2 GiB-safe), and the document codecs must accept both the
+v2 ``__sec__`` refs and the legacy v1 ``__nd__`` base64 triples.  On
+top of the codec: pipelining (many in-flight per connection, responses
+out of order), protocol negotiation (v1 clients against a v2 server,
+counted by ``transport.proto_v1``), and the shared-memory lane with
+its socket fallback.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from cme213_tpu.core import metrics, trace
+from cme213_tpu.core.resilience import VirtualClock
+from cme213_tpu.serve import OK, Server
+from cme213_tpu.serve import wire
+from cme213_tpu.serve.loadgen import build_mix
+from cme213_tpu.serve.transport import (
+    TransportClient,
+    TransportServer,
+    send_frame,
+    recv_frame,
+)
+from cme213_tpu.serve.workloads import ADAPTERS
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    trace.clear_events()
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _roundtrip_socket(arrays, meta=None):
+    a, b = socket.socketpair()
+    try:
+        wire.send_buffers(a, wire.pack_frame(
+            wire.FT_REQUEST, 42, meta or {}, arrays))
+        first4 = wire.recv_exact(b, 4)
+        return wire.read_frame_rest(b, first4)
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------------------------------ sections
+
+#: the fuzz matrix the 0-d/endianness satellite demands: every dtype
+#: crossed with every shape, bitwise both ways
+DTYPES = ("<f8", ">f8", "<f4", ">f4", "<i8", ">i4", "<u2", "|u1", "|b1",
+          "<c16")
+SHAPES = ((), (0,), (1,), (7,), (5, 3), (2, 0, 3), (2, 3, 4))
+
+
+def _make(dtype, shape, seed):
+    rng = np.random.default_rng(seed)
+    n = int(np.prod(shape)) if shape else 1
+    base = rng.integers(0, 100, size=max(n, 1))
+    arr = base.astype(np.dtype(dtype))[:n].reshape(shape)
+    return arr
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_section_roundtrip_bitwise_every_dtype_shape(dtype, shape):
+    arr = _make(dtype, shape, seed=hash((dtype, shape)) % 2**16)
+    ftype, rid, meta, sections = _roundtrip_socket([arr])
+    assert (ftype, rid) == (wire.FT_REQUEST, 42)
+    (back,) = sections
+    assert back.dtype == arr.dtype          # byte order preserved
+    assert back.shape == arr.shape          # incl. 0-d and empty dims
+    assert back.tobytes() == arr.tobytes()
+
+
+def test_section_roundtrip_noncontiguous_views():
+    base = np.arange(48, dtype="<f8").reshape(6, 8)
+    cases = [np.asfortranarray(base),        # F-contiguous
+             base[::2, 1::3],                # strided view
+             base.T]                         # transposed view
+    ftype, _, _, sections = _roundtrip_socket(cases)
+    for src, back in zip(cases, sections):
+        assert back.shape == src.shape
+        assert np.ascontiguousarray(src).tobytes() == back.tobytes()
+
+
+def test_section_roundtrip_0d_keeps_0d():
+    # the PR 15 edge: ascontiguousarray silently promotes () to (1,);
+    # the binary layer must hand back a true 0-d
+    for val in (np.float64(2.5), np.array(7, dtype=">i8")):
+        _, _, _, (back,) = _roundtrip_socket([val])
+        assert back.shape == ()
+        assert back.tobytes() == np.asarray(val).tobytes()
+
+
+def test_section_length_fields_are_2gib_safe():
+    # descriptors carry nbytes as an unsigned 8-byte field and dims as
+    # signed 8-byte ints: sizes past 2**31 survive the pack/unpack
+    big = 5 * 2**31 + 13
+    desc = wire._SECT.pack(3, 1, 0, big)
+    dlen, ndim, flags, nbytes = wire._SECT.unpack(desc)
+    assert nbytes == big
+    assert wire._DIM.unpack(wire._DIM.pack(2**40))[0] == 2**40
+
+
+def test_parse_frame_matches_socket_read():
+    arrays = [np.arange(12, dtype="<i4").reshape(3, 4),
+              np.array(1.5, dtype=">f8"), np.empty((0, 2), "<f4")]
+    meta = {"op": "stub", "tenant": "t0", "nested": {"k": [1, 2.5]}}
+    blob = wire.frame_bytes(wire.FT_RESPONSE, 7, meta, arrays)
+    ftype, rid, m2, secs = wire.parse_frame(blob)
+    assert (ftype, rid, m2) == (wire.FT_RESPONSE, 7, meta)
+    for src, back in zip(arrays, secs):
+        assert back.dtype == src.dtype and back.shape == src.shape
+        assert back.tobytes() == src.tobytes()
+
+
+def test_malformed_frames_raise_wire_error():
+    good = bytearray(wire.frame_bytes(wire.FT_REQUEST, 1, {"op": "x"}))
+    bad_magic = bytes([0xC3, 0x00]) + bytes(good[2:])
+    with pytest.raises(wire.WireError, match="magic"):
+        wire.parse_frame(bad_magic)
+    bad_ver = bytearray(good)
+    bad_ver[4] = 99
+    with pytest.raises(wire.WireError, match="version"):
+        wire.parse_frame(bytes(bad_ver))
+
+
+# ------------------------------------------------------ document codecs
+
+def test_decode_value_accepts_both_nd_and_sec():
+    arr = np.arange(5, dtype="<f4")
+    v1_doc = wire.encode_value(arr, wire.nd_b64)
+    assert wire.decode_value(v1_doc).tobytes() == arr.tobytes()
+    sw = wire.SectionWriter()
+    v2_doc = wire.encode_value({"xs": [arr, 3]}, sw)
+    got = wire.decode_value(v2_doc, sw.arrays)
+    assert got["xs"][0].tobytes() == arr.tobytes() and got["xs"][1] == 3
+    with pytest.raises(wire.WireError, match="__sec__"):
+        wire.decode_value({"__sec__": 0})    # sectionless context
+
+
+def test_v2_payload_roundtrip_every_op_bitwise():
+    specs = build_mix("spmv,heat,cipher", 6, seed=3)
+    for spec in specs:
+        sw = wire.SectionWriter()
+        doc = wire.encode_payload(spec.op, spec.payload, sw)
+        back = wire.decode_payload(spec.op, doc, sw.arrays)
+        if spec.op == "spmv_scan":
+            for f in ("a", "s", "k", "x"):
+                assert np.asarray(getattr(back, f)).tobytes() == \
+                    np.ascontiguousarray(getattr(spec.payload, f)).tobytes()
+        elif spec.op == "cipher":
+            assert back.text.tobytes() == spec.payload.text.tobytes()
+            assert back.shift == spec.payload.shift
+
+
+def test_inline_sections_downgrades_sec_refs():
+    arr = np.arange(4, dtype="<u2")
+    sw = wire.SectionWriter()
+    doc = {"value": wire.encode_value([arr], sw), "status": "ok"}
+    flat = wire.inline_sections(doc, sw.arrays)
+    assert "__nd__" in flat["value"]["__seq__"][0]
+    assert wire.decode_value(flat["value"])[0].tobytes() == arr.tobytes()
+
+
+# ------------------------------------------------------------ transport
+
+def _cipher_server(**kw):
+    server = Server(adapters=ADAPTERS, clock=VirtualClock(), max_batch=8)
+    return TransportServer(server, drive="thread", **kw).start()
+
+
+def test_pipelined_submits_resolve_out_of_order():
+    ts = _cipher_server()
+    try:
+        specs = build_mix("cipher", 6, seed=9)
+        with TransportClient(ts.addr) as c:
+            assert c.proto == 2
+            rids = [c.submit(s.op, s.payload) for s in specs]
+            # resolve in reverse submission order on one connection
+            results = {rid: c.result(rid) for rid in reversed(rids)}
+        assert all(results[r].status == OK for r in rids)
+        assert [results[r].rid for r in rids] == sorted(
+            results[r].rid for r in rids)
+        # client-side attribution rode along
+        info = results[rids[0]].client
+        assert info["encode_ms"] >= 0 and info["rtt_ms"] > 0
+    finally:
+        ts.close()
+
+
+def test_v1_client_still_served_and_counted():
+    ts = _cipher_server()
+    try:
+        spec = build_mix("cipher", 1, seed=4)[0]
+        before = metrics.counter("transport.proto_v1").value
+        with TransportClient(ts.addr, proto=1) as c:
+            assert c.proto == 1
+            res = c.solve(spec.op, spec.payload)
+        assert res.status == OK
+        assert metrics.counter("transport.proto_v1").value > before
+        after_v1 = metrics.counter("transport.proto_v1").value
+        # v2 clients leave the legacy counter alone
+        with TransportClient(ts.addr) as c:
+            assert c.solve(spec.op, spec.payload).status == OK
+        assert metrics.counter("transport.proto_v1").value == after_v1
+    finally:
+        ts.close()
+
+
+def test_hello_negotiation_reports_v2():
+    ts = _cipher_server()
+    try:
+        with TransportClient(ts.addr) as c:
+            pong = c.control("hello", proto=2)
+            assert pong["ok"] and pong["proto"] == wire.VERSION
+    finally:
+        ts.close()
+
+
+def test_codec_histograms_and_span_tags_populate():
+    ts = _cipher_server()
+    try:
+        spec = build_mix("cipher", 1, seed=2)[0]
+        with TransportClient(ts.addr) as c:
+            assert c.solve(spec.op, spec.payload).status == OK
+        snap = metrics.snapshot()["histograms"]
+        assert snap["serve.request.decode_ms"]["count"] >= 1
+        assert snap["serve.request.encode_ms"]["count"] >= 1
+        names = {e["event"] for e in trace.events()}
+        assert {"request-serialized", "request-deserialized"} <= names
+    finally:
+        ts.close()
+
+
+def test_shm_lane_negotiates_and_serves_bitwise():
+    ts = _cipher_server()
+    try:
+        specs = build_mix("cipher", 4, seed=13)
+        with TransportClient(ts.addr, shm=True) as c:
+            if not c.shm_active:
+                pytest.skip("shared memory unavailable on this host")
+            results = [c.solve(s.op, s.payload) for s in specs]
+        assert all(r.status == OK for r in results)
+        # same requests over plain sockets: bitwise-equal values
+        with TransportClient(ts.addr) as c:
+            refs = [c.solve(s.op, s.payload) for s in specs]
+        for res, ref in zip(results, refs):
+            assert np.asarray(res.value).tobytes() == \
+                np.asarray(ref.value).tobytes()
+    finally:
+        ts.close()
+
+
+def test_shm_oversized_frames_fall_back_to_socket():
+    ts = _cipher_server()
+    try:
+        spec = build_mix("cipher", 1, seed=8)[0]
+        with TransportClient(ts.addr, shm=True, shm_slots=2,
+                             shm_slot_bytes=256) as c:
+            if not c.shm_active:
+                pytest.skip("shared memory unavailable on this host")
+            res = c.solve(spec.op, spec.payload)   # payload > slot
+            assert res.status == OK
+            assert c._conn.lane.tx.fallbacks >= 1
+    finally:
+        ts.close()
+
+
+def test_raw_v1_socket_frames_against_v2_server():
+    # a hand-rolled legacy client: length-prefixed JSON, one in flight
+    ts = _cipher_server()
+    try:
+        from cme213_tpu.serve.transport import encode_payload
+        spec = build_mix("cipher", 1, seed=5)[0]
+        host, port = ts.addr.rsplit(":", 1)
+        with socket.create_connection((host, int(port)), timeout=10) as s:
+            send_frame(s, {"control": "ping"})
+            assert recv_frame(s)["ok"] is True
+            send_frame(s, {"op": spec.op,
+                           "payload": encode_payload(spec.op, spec.payload),
+                           "tenant": "legacy"})
+            resp = recv_frame(s)
+        assert resp["status"] == OK and resp["tenant"] == "legacy"
+    finally:
+        ts.close()
