@@ -158,6 +158,25 @@ class _Clause:
         self.calls += 1
         return self.nth <= self.calls < self.nth + self.count
 
+    def __str__(self) -> str:
+        """Canonical spec text: ``FaultPlan.parse(str(c))`` rebuilds an
+        identical clause (modulo the mutable ``calls`` counter), which is
+        what lets the chaos runner bank cocktails as replayable JSON
+        fixtures (``core/chaos.py``)."""
+        if self.kind == "unreachable":
+            return f"unreachable:{self.nth}:{self.count}"
+        if self.kind == "stage":
+            return f"stage:{self.op}:{self.stage}:{self.nth}:{self.count}"
+        if self.kind == "slow":
+            return f"slow:{self.op}:{self.ms!r}:{self.nth}:{self.count}"
+        if self.kind == "drift":
+            # count is the parser's persistent 1<<30, not spec text
+            return f"drift:{self.op}:{self.ms!r}:{self.nth}"
+        if self.kind == "fail":
+            return f"fail:{self.op}:{self.nth}:{self.count}"
+        # nan | wrong | oom | ckpt | rankkill | replica-kill: kind:op:nth
+        return f"{self.kind}:{self.op}:{self.nth}"
+
 
 @dataclass
 class FaultPlan:
@@ -254,6 +273,17 @@ class FaultPlan:
     def _matching(self, kind: str, op: str):
         return [c for c in self.clauses if c.kind == kind and c.op == op]
 
+    def __str__(self) -> str:
+        """The comma-joined spec; ``parse(str(plan))`` round-trips."""
+        return ",".join(str(c) for c in self.clauses)
+
+    def reset_counters(self) -> "FaultPlan":
+        """Zero every clause's call counter so an already-used plan can
+        be re-armed fresh (fixture replay, repeated chaos campaigns)."""
+        for c in self.clauses:
+            c.calls = 0
+        return self
+
 
 # cache: None = env not read yet; False = read and disabled
 _PLAN: FaultPlan | None | bool = None
@@ -270,9 +300,18 @@ def active() -> FaultPlan | None:
 
 def install(spec: str) -> FaultPlan:
     """Install a plan programmatically (tests); overrides the env."""
+    return install_plan(FaultPlan.parse(spec))
+
+
+def install_plan(plan: FaultPlan) -> FaultPlan:
+    """Install an already-built :class:`FaultPlan`, overriding the env —
+    the chaos runner's in-process arming path (``core/chaos.py``): a
+    drawn cocktail is armed, driven, then swapped back out without ever
+    touching ``CME213_FAULTS``.  The caller owns counter state; use
+    ``plan.reset_counters()`` to re-arm a used plan fresh."""
     global _PLAN
-    _PLAN = FaultPlan.parse(spec)
-    return _PLAN
+    _PLAN = plan
+    return plan
 
 
 def reset() -> None:
@@ -368,6 +407,19 @@ def maybe_perturb(op: str, value):
             leaves[i] = arr
             _record("wrong", op, leaf=i)
             break
+    else:
+        # no float leaf (integer-keyed probes, e.g. the sort golden
+        # gate): flip one element's bits instead — still ONE element,
+        # still finite/large, still dtype-preserving
+        for i, leaf in enumerate(leaves):
+            arr = np.asarray(leaf)
+            if np.issubdtype(arr.dtype, np.integer) and arr.size:
+                arr = np.array(arr)
+                flat = arr.reshape(-1)
+                flat[0] = ~flat[0]
+                leaves[i] = arr
+                _record("wrong", op, leaf=i)
+                break
     return treedef.unflatten(leaves) if treedef is not None else leaves[0]
 
 

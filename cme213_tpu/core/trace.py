@@ -172,6 +172,11 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "drift-budget-burn": ("op", "rung", "burn_short", "burn_long",
                           "threshold"),
     "drift-budget-ok": ("op", "rung", "burn_short"),
+    # game-day chaos campaigns (core/chaos.py): one per campaign run,
+    # one per invariant violation, one per completed ddmin shrink
+    "chaos-campaign": ("seed", "campaign", "cocktail", "backend"),
+    "chaos-violation": ("campaign", "invariant", "detail"),
+    "chaos-shrunk": ("campaign", "from_clauses", "to_clauses", "cocktail"),
     # flight recorder (core/flight.py)
     "flight-dump": ("reason", "path", "events"),
     # telemetry itself

@@ -119,6 +119,12 @@ def _fleet(argv: list[str]) -> int:
     return fleet_cli.main(argv)
 
 
+def _chaos(argv: list[str]) -> int:
+    from . import chaos_cli
+
+    return chaos_cli.main(argv)
+
+
 WORKLOADS: dict[str, Workload] = {
     w.name: w
     for w in (
@@ -192,6 +198,17 @@ WORKLOADS: dict[str, Workload] = {
                  "breakers, supervised relaunch with zero accepted-"
                  "request loss, SLO-burn autoscaling); worker: one "
                  "replica process (spawned by up)", _fleet),
+        # not a reference workload: the game-day layer composing all of
+        # the above — seeded fault cocktails armed against a live
+        # serving run, global invariants checked after every campaign,
+        # violations ddmin-shrunk to minimal replayable fixtures
+        Workload("chaos", "robustness", "run: seeded chaos campaigns "
+                 "(randomized fault cocktails from the CME213_FAULTS "
+                 "grammar, matrix-filtered, armed against a live "
+                 "inproc/fleet serving run; zero-loss + bitwise-"
+                 "conformance + SLO-report + one-trace + no-leak "
+                 "invariants; violations shrink to banked fixtures); "
+                 "draw | replay | matrix", _chaos),
     )
 }
 

@@ -44,7 +44,7 @@ from .server import Server
 #: transport-measurement op: the adapter echoes the payload with no jax
 #: on the path, so a closed-loop run over it measures the wire + queue
 #: cost alone (the tier-1 >= 10k req/s gate drives this mix).
-MIX_OPS = ("spmv", "heat", "cipher", "stub")
+MIX_OPS = ("spmv", "heat", "cipher", "sort", "stub")
 
 
 def build_mix(mix: str, requests: int, seed: int = 0,
@@ -79,6 +79,13 @@ def build_mix(mix: str, requests: int, seed: int = 0,
             specs.append(RequestSpec(
                 "stub", rng.integers(0, 255, size=stub_bytes)
                 .astype(np.uint8),
+                deadline_ms=deadline_ms, tenant=tenant))
+        elif op == "sort":
+            # two shape classes, like spmv: same-sized requests batch,
+            # uint32 keys so every rung (lax/radix/bitonic) is eligible
+            n = (512, 1024)[(i // len(ops)) % 2]
+            specs.append(RequestSpec(
+                "sort", rng.integers(0, 2**32, size=n, dtype=np.uint32),
                 deadline_ms=deadline_ms, tenant=tenant))
         elif op == "heat":
             from ..config import SimParams

@@ -442,6 +442,8 @@ def encode_payload(op: str, payload, nd) -> dict:
                           "bc_bottom", "bc_right")}
     if op == "cipher":
         return {"text": nd(payload.text), "shift": int(payload.shift)}
+    if op == "sort":
+        return {"keys": nd(payload)}
     if op == "stub":
         return {"x": nd(payload)}
     raise ValueError(f"no wire codec for op {op!r}")
@@ -465,6 +467,8 @@ def decode_payload(op: str, doc: dict, sections=None):
 
         return CipherRequest(text=decode_value(doc["text"], sections),
                              shift=int(doc["shift"]))
+    if op == "sort":
+        return decode_value(doc["keys"], sections)
     if op == "stub":
         return decode_value(doc["x"], sections)
     raise ValueError(f"no wire codec for op {op!r}")

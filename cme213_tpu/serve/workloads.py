@@ -216,6 +216,118 @@ class CipherAdapter:
         return None  # bytes in ≈ bytes out: admission adds nothing here
 
 
+def _sort_gate(n: int, rung: str) -> bool:
+    """One verdict per (bucket, rung): prove the device sort matches the
+    host ``np.sort`` golden bitwise before the bucket serves — hw4's
+    offline checker (``radixsort.cpp``'s host compare) made an in-path
+    gate.  Probe keys are fixed-seed, so the verdict is deterministic
+    and cacheable (``CME213_CONFORMANCE_CACHE``)."""
+    from ..core import conformance
+
+    probe = np.random.default_rng(99).integers(
+        0, 2**32, size=n, dtype=np.uint32)
+    return conformance.check(
+        "serve.sort", rung, shape_class=f"n{n}/u32",
+        candidate=lambda: _sort_one(probe, rung),
+        reference=lambda: np.sort(probe)).ok
+
+
+def _sort_one(keys: np.ndarray, rung: str) -> np.ndarray:
+    """One unbatched solve on the named rung (gate probes, references)."""
+    import jax.numpy as jnp
+
+    from ..ops.sort import bitonic_sort, radix_sort, sort as lax_sort
+
+    x = jnp.asarray(np.asarray(keys, np.uint32))
+    if rung == "lax":
+        return np.asarray(lax_sort(x))
+    if rung == "radix":
+        return np.asarray(radix_sort(
+            x, block_size=_sort_block(int(x.shape[0]))))
+    if rung == "bitonic":
+        return np.asarray(bitonic_sort(x))
+    raise ValueError(f"unknown sort rung {rung!r}")
+
+
+def _sort_block(n: int) -> int:
+    # serving sizes are far below the CLI's 8192 default; a block the
+    # size of the (padded) input keeps the one-hot histogram tensors
+    # CPU-affordable without changing the 4-phase structure
+    return min(8192, max(256, n))
+
+
+class SortAdapter:
+    """``np.ndarray`` uint32 key payloads over the hw4 sort pipelines
+    (``ops/sort.py``).  Three bitwise-identical rungs — ``lax`` (the
+    library path; single-lane batches dispatch through
+    ``ops.sort.sort_auto`` so a tuned winner serves), ``radix`` (the
+    4-phase LSD passes), ``bitonic`` (the merge network) — each gated
+    once per (bucket, rung) against the host ``np.sort`` golden before
+    it serves (:func:`_sort_gate`).  Sorted uint32 keys are unique per
+    input whatever the kernel, so every rung is bitwise-substitutable:
+    the chaos campaigns' fourth op family for breaker/demotion drills."""
+
+    op = "sort"
+
+    def shape_class(self, keys, coarse: bool = False) -> str:
+        return f"n{int(np.asarray(keys).shape[0])}/u32"
+
+    def rungs(self, degraded: bool = False) -> tuple[str, ...]:
+        return ("lax",) if degraded else ("lax", "radix", "bitonic")
+
+    def run_batch(self, payloads, rung: str, coarse: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import check_op, programs, span
+        from ..ops.sort import bitonic_sort, radix_sort, sort_auto
+
+        n = int(np.asarray(payloads[0]).shape[0])
+        if not _sort_gate(n, rung):
+            raise RuntimeError(
+                f"np.sort golden probe failed for sort bucket n{n} on "
+                f"rung {rung!r}")
+        b = len(payloads)
+        if rung == "lax" and b == 1:
+            # single lane rides the tuned dispatch (ops.sort.sort_auto):
+            # a `tune run` winner serves here, and the golden gate above
+            # holds whatever kernel it picked to bitwise np.sort
+            out = sort_auto(
+                jnp.asarray(np.asarray(payloads[0], np.uint32)))
+            return [np.asarray(out)]
+        if rung == "lax":
+            def kernel_fn(x):
+                from jax import lax
+                return lax.sort(x, dimension=1)
+        elif rung == "radix":
+            kernel_fn = jax.vmap(
+                lambda x: radix_sort(x, block_size=_sort_block(n)))
+        elif rung == "bitonic":
+            kernel_fn = jax.vmap(bitonic_sort)
+        else:
+            raise ValueError(f"unknown sort rung {rung!r}")
+        shape_class = f"n{n}/u32/b{b}"
+
+        def warm(fn):
+            check_op(f"sort_batched.{rung}",
+                     fn(jnp.zeros((b, n), jnp.uint32)))
+
+        runner = programs.get("sort_batched", rung, shape_class,
+                              lambda: kernel_fn, dtype="u32", warm=warm,
+                              batch=b)
+        data = jnp.asarray(np.stack([np.asarray(p, np.uint32)
+                                     for p in payloads]))
+        with span("sort_batched.run", kernel=rung,
+                  shape_class=shape_class) as sp:
+            out = runner(data)
+            sp.block(out)
+        out = np.asarray(out)
+        return [out[i] for i in range(b)]
+
+    def preflight_builder(self, payloads, rung: str, coarse: bool = False):
+        return None  # keys in ≈ keys out: admission adds nothing here
+
+
 class StubAdapter:
     """``np.ndarray`` payloads echoed back untouched, no jax anywhere on
     the path.  This is the transport's honest-measurement op: with the
@@ -243,4 +355,5 @@ class StubAdapter:
 
 #: the default adapter registry — the hw workload mix as request types
 ADAPTERS = {a.op: a for a in (SpmvAdapter(), HeatAdapter(),
-                              CipherAdapter(), StubAdapter())}
+                              CipherAdapter(), SortAdapter(),
+                              StubAdapter())}

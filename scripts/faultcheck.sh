@@ -46,6 +46,13 @@
 #     after serving traffic leaves a parseable flight-*.json black box
 #     (reason, traceback, pre-crash events, metrics at death) that
 #     `trace flight` renders.
+# And per ISSUE 17 (chaos campaigns):
+# 11. one in-process chaos campaign: a seeded multi-clause fault
+#     cocktail armed against a live serving run, every global invariant
+#     (zero loss, bitwise conformance, SLO report, one trace id, no
+#     leaks) green — and the drawer is seed-deterministic (two draws of
+#     the same seed are byte-identical).  The full game day (8 fleet
+#     campaigns + fixture replay) is the tier1.yml chaos gate.
 # On ANY failing step the merged gang timeline is printed for
 # debuggability before the workspace is cleaned up.
 set -euo pipefail
@@ -304,5 +311,25 @@ PY
 python -m cme213_tpu trace flight "$DUMP" > "$OUT/flight-render.txt"
 grep -q "reason 'unhandled-exception'" "$OUT/flight-render.txt"
 grep -q "injected serve crash" "$OUT/flight-render.txt"
+
+# 11. chaos campaign smoke: the drawer is seed-deterministic, and one
+# in-process campaign (seeded cocktail armed against a live serving
+# run) holds all five global invariants
+python -m cme213_tpu chaos draw --seed 7 --campaigns 2 \
+  --mix cipher,sort > "$OUT/draw-a.txt"
+python -m cme213_tpu chaos draw --seed 7 --campaigns 2 \
+  --mix cipher,sort > "$OUT/draw-b.txt"
+cmp "$OUT/draw-a.txt" "$OUT/draw-b.txt"
+python -m cme213_tpu chaos run --seed 7 --campaigns 1 \
+  --mix cipher,sort --requests 10 --json > "$OUT/chaos.json"
+python - "$OUT/chaos.json" <<'PY'
+import json, sys
+out = json.load(open(sys.argv[1]))
+assert out["ok"] and out["violations_total"] == 0, out
+c = out["campaigns"][0]
+assert len(c["cocktail"].split(",")) >= 2, c["cocktail"]
+assert c["report"]["served"] + c["report"]["shed"] == 10, c["report"]
+print(f"chaos campaign OK: {c['cocktail']} held all invariants")
+PY
 
 echo "faultcheck OK"

@@ -1,0 +1,378 @@
+"""Game-day chaos campaigns (``core/chaos.py``): the cocktail grammar
+round-trip, the clause-compatibility matrix, the seeded drawer's
+determinism, the ddmin shrinker on synthetic predicates, the
+conformance-gated sort adapter, in-process campaigns end-to-end (benign
+cocktails hold all five invariants; a handicapped drill violates,
+shrinks to a minimal cocktail, banks, and replays), and the shipped
+fixture bank.  The live-fleet campaign is ``slow``-marked; the CI chaos
+gate runs it against real replica subprocesses.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cme213_tpu.core import chaos, conformance, faults, metrics, numerics, trace
+from cme213_tpu.core.chaos import (
+    MATRIX,
+    TOPOLOGY,
+    CampaignResult,
+    bank_fixture,
+    compatible,
+    ddmin,
+    draw_cocktail,
+    replay_fixture,
+    run_campaign,
+    run_campaigns,
+    shrink,
+    validate_cocktail,
+)
+from cme213_tpu.core.faults import FaultPlan, _Clause
+from cme213_tpu.serve.workloads import ADAPTERS
+
+FIXTURES = sorted(glob.glob(os.path.join(
+    os.path.dirname(__file__), "chaos_fixtures", "*.json")))
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    trace.clear_events()
+    metrics.reset()
+    yield
+    faults.reset()
+    conformance.reset()
+    numerics.reset()
+    metrics.reset()
+    trace.clear_events()
+
+
+# ------------------------------------------------- grammar round-trip
+
+def test_clause_str_roundtrip_every_kind():
+    spec = ("fail:serve.cipher.packed:2:3,nan:solver:1,wrong:probe:1,"
+            "oom:chunk:2,slow:serve.sort:50.0:1:2,drift:op.rung:0.001:1,"
+            "stage:serve.spmv_scan.blocked:execute:2:1,unreachable:1:3,"
+            "rankkill:1:2,replica-kill:0:1,ckpt:truncate:1,ckpt:commit:2")
+    plan = FaultPlan.parse(spec)
+    again = FaultPlan.parse(str(plan))
+    assert len(again.clauses) == len(plan.clauses)
+    for a, b in zip(plan.clauses, again.clauses):
+        assert (a.kind, a.op, a.nth, a.count, a.ms, a.stage) == \
+               (b.kind, b.op, b.nth, b.count, b.ms, b.stage)
+
+
+def test_drawn_cocktails_roundtrip(seeds=range(6)):
+    ops = ["cipher", "sort", "spmv_scan", "heat"]
+    for s in seeds:
+        plan = draw_cocktail(np.random.default_rng([s, 0]), "inproc", ops)
+        again = FaultPlan.parse(str(plan))
+        assert str(again) == str(plan)
+        assert 2 <= len(plan.clauses) <= 5
+
+
+def test_install_plan_overrides_env_and_reset_restores(monkeypatch):
+    monkeypatch.setenv("CME213_FAULTS", "fail:env-op:1")
+    faults.reset()
+    assert faults.active().clauses[0].op == "env-op"
+    plan = faults.install_plan(FaultPlan.parse("fail:prog-op:1"))
+    assert faults.active() is plan
+    assert faults.active().clauses[0].op == "prog-op"
+    faults.reset()                      # back to reading the env
+    assert faults.active().clauses[0].op == "env-op"
+
+
+def test_reset_counters_rearms_clauses():
+    plan = FaultPlan.parse("fail:op:1:1")
+    faults.install_plan(plan)
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_fail("op")
+    faults.maybe_fail("op")             # count exhausted: no longer fires
+    plan.reset_counters()
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_fail("op")         # fires again from scratch
+
+
+# ------------------------------------------------- compatibility matrix
+
+def test_topology_matches_live_adapters():
+    assert set(TOPOLOGY) == set(ADAPTERS)
+    for op, topo in TOPOLOGY.items():
+        assert topo["rungs"] == ADAPTERS[op].rungs(False), op
+
+
+def test_matrix_covers_full_grammar():
+    # every kind the parser accepts has a matrix row, and every row
+    # carries a documented reason
+    assert set(MATRIX) == {"fail", "nan", "wrong", "oom", "slow", "drift",
+                           "stage", "unreachable", "rankkill",
+                           "replica-kill", "ckpt"}
+    for rule in MATRIX.values():
+        assert rule.reason, rule.kind
+
+
+def test_validate_flags_ineligible_and_backend():
+    assert any("ineligible" in p for p in validate_cocktail(
+        FaultPlan.parse("nan:solver:1,fail:serve.cipher.packed:1"),
+        "inproc"))
+    assert any("backend" in p for p in validate_cocktail(
+        FaultPlan.parse("replica-kill:0:1,fail:serve.cipher.packed:1"),
+        "inproc"))
+    assert validate_cocktail(FaultPlan.parse(
+        "replica-kill:0:1,fail:serve.cipher.packed:1"), "fleet") == []
+
+
+def test_compatible_rejects_conflicts_duplicates_caps():
+    drift = _Clause("drift", "serve.heat.xla", nth=1, ms=1e-3)
+    kill = _Clause("replica-kill", "0", nth=1)
+    assert not compatible([drift], kill)[0]         # declared conflict
+    assert not compatible([kill], drift)[0]         # symmetric
+    f = _Clause("fail", "serve.cipher.packed", nth=1, count=1)
+    assert not compatible([f], f)[0]                # duplicate target
+    s1 = _Clause("stage", "serve.sort.lax", stage="execute")
+    s2 = _Clause("stage", "serve.sort.radix", stage="execute")
+    assert compatible([s1], s2)[0] is False         # stage cap is 1
+
+
+def test_wrong_never_codrawn_with_ladder_failure():
+    # the chaos-s2000-c0 find, encoded: a poisoned probe plus rung
+    # failures on the same ladder can exhaust it
+    wrong = _Clause("wrong", "serve.sort", nth=1)
+    fail_sort = _Clause("fail", "serve.sort.lax", nth=1, count=1)
+    fail_ciph = _Clause("fail", "serve.cipher.packed", nth=1, count=1)
+    assert not compatible([wrong], fail_sort)[0]
+    assert not compatible([fail_sort], wrong)[0]
+    assert compatible([wrong], fail_ciph)[0]        # other ladders fine
+
+
+def test_draw_is_seed_deterministic_and_valid():
+    ops = ["cipher", "sort", "spmv_scan", "heat"]
+    for backend in ("inproc", "fleet"):
+        for i in range(8):
+            a = draw_cocktail(np.random.default_rng([5, i]), backend, ops)
+            b = draw_cocktail(np.random.default_rng([5, i]), backend, ops)
+            assert str(a) == str(b)
+            assert validate_cocktail(a, backend) == []
+
+
+def test_inproc_draw_never_contains_kill():
+    for i in range(12):
+        plan = draw_cocktail(np.random.default_rng([9, i]), "inproc",
+                             ["cipher", "sort"])
+        assert not any(c.kind in ("replica-kill", "rankkill")
+                       for c in plan.clauses)
+
+
+# ---------------------------------------------------------- ddmin units
+
+def test_ddmin_single_culprit():
+    assert ddmin(list("abcdefgh"), lambda s: "e" in s) == ["e"]
+
+
+def test_ddmin_interacting_pair():
+    got = ddmin(list("abcdefgh"), lambda s: "b" in s and "g" in s)
+    assert sorted(got) == ["b", "g"]
+
+
+def test_ddmin_preserves_order_and_already_minimal():
+    got = ddmin([3, 1, 2], lambda s: 1 in s and 2 in s)
+    assert got == [1, 2]
+    assert ddmin([7], lambda s: True) == [7]
+
+
+def test_shrink_drops_clauses_and_simplifies_params():
+    plan = FaultPlan.parse(
+        "slow:serve.cipher:50.0:2:3,fail:serve.cipher.packed:2:3,"
+        "drift:serve.heat.xla:0.001:1")
+
+    def failing(p):
+        return any(c.kind == "fail" for c in p.clauses)
+
+    minimal = shrink(plan, failing)
+    assert len(minimal.clauses) == 1
+    c = minimal.clauses[0]
+    assert (c.kind, c.nth, c.count) == ("fail", 1, 1)   # params shrunk too
+
+
+# --------------------------------------------- conformance-gated sort
+
+def test_sort_adapter_every_rung_bitwise():
+    adapter = ADAPTERS["sort"]
+    keys = np.random.default_rng(0).integers(
+        0, 2**32, size=(3, 512), dtype=np.uint32)
+    golden = np.sort(keys, axis=1)
+    for rung in adapter.rungs(False):
+        out = adapter.run_batch(list(keys), rung)
+        for lane, ref in zip(out, golden):
+            assert np.asarray(lane).tobytes() == ref.tobytes(), rung
+
+
+def test_sort_golden_gate_refuses_poisoned_rung():
+    adapter = ADAPTERS["sort"]
+    keys = [np.random.default_rng(1).integers(
+        0, 2**32, size=512, dtype=np.uint32)]
+    with faults.injected("wrong:serve.sort:1"):
+        conformance.reset()
+        with pytest.raises(RuntimeError, match="golden probe"):
+            adapter.run_batch(keys, "lax")
+    conformance.reset()
+    out = adapter.run_batch(keys, "lax")    # disarmed: serves again
+    assert np.asarray(out[0]).tobytes() == np.sort(keys[0]).tobytes()
+
+
+def test_sort_in_loadgen_mix_and_wire():
+    from cme213_tpu.serve.loadgen import build_mix
+    from cme213_tpu.serve.transport import decode_payload, encode_payload
+
+    specs = build_mix("cipher,sort", 8, seed=2)
+    sorts = [s for s in specs if s.op == "sort"]
+    assert sorts and {int(np.asarray(s.payload).shape[0])
+                      for s in sorts} == {512, 1024}
+    doc = json.loads(json.dumps(encode_payload("sort", sorts[0].payload)))
+    back = decode_payload("sort", doc)
+    assert np.asarray(back).tobytes() == \
+        np.asarray(sorts[0].payload).tobytes()
+
+
+# ------------------------------------------------- campaigns end-to-end
+
+def test_benign_campaign_holds_all_invariants():
+    res = run_campaign(
+        "fail:serve.cipher.packed:1:2,slow:serve.cipher:20.0:1:2",
+        backend="inproc", mix="cipher", requests=8, seed=3)
+    assert res.ok, [v.as_dict() for v in res.violations]
+    assert res.report["served"] + res.report["shed"] == 8
+    names = [e["event"] for e in trace.events("chaos-campaign")]
+    assert names == ["chaos-campaign"]
+
+
+def test_campaign_is_deterministic_per_seed():
+    kw = dict(backend="inproc", mix="cipher", requests=6, seed=7)
+    a = run_campaign("fail:serve.cipher.packed:1:1", **kw)
+    b = run_campaign("fail:serve.cipher.packed:1:1", **kw)
+    assert a.ok and b.ok
+    assert a.cocktail == b.cocktail
+    assert a.report["served"] == b.report["served"]
+
+
+def test_inproc_campaign_refuses_kill_clauses():
+    with pytest.raises(ValueError, match="kill"):
+        run_campaign("replica-kill:0:1", backend="inproc", mix="cipher",
+                     requests=2, seed=0)
+
+
+def test_unknown_handicap_and_backend_rejected():
+    with pytest.raises(ValueError, match="handicap"):
+        run_campaign("fail:x:1", backend="inproc", mix="cipher",
+                     requests=2, seed=0, handicaps=("no-such",))
+    with pytest.raises(ValueError, match="backend"):
+        run_campaign("fail:x:1", backend="warp", mix="cipher",
+                     requests=2, seed=0)
+
+
+def test_drill_violates_shrinks_banks_and_replays(tmp_path):
+    # the deliberate game-day drill: drift on the serving rung with
+    # drift-compensation handicapped off -> conformance violation ->
+    # ddmin to a minimal (<= 2 clause) cocktail -> banked fixture
+    # reproduces on replay
+    cocktail = ("drift:serve.spmv_scan.blocked:0.001:1,"
+                "slow:serve.spmv_scan:20.0:1:1")
+    kw = dict(backend="inproc", mix="spmv", requests=6, seed=5,
+              handicaps=("drift-compensation",))
+    res = run_campaign(cocktail, **kw)
+    assert {v.invariant for v in res.violations} == {"conformance"}
+    assert len(trace.events("chaos-violation")) >= 1
+
+    def failing(p):
+        return bool(run_campaign(p, **kw).violations)
+
+    minimal = shrink(FaultPlan.parse(cocktail), failing)
+    assert len(minimal.clauses) <= 2
+    assert minimal.clauses[0].kind == "drift"
+
+    path = bank_fixture(res, minimal, directory=str(tmp_path),
+                        handicaps=("drift-compensation",))
+    replayed, expected, observed = replay_fixture(path)
+    assert expected == observed == ["conformance"]
+
+
+def test_drift_with_compensation_is_conformant():
+    # same drift cocktail, no handicap: the checker compensates the
+    # declared scale exactly, so the campaign is clean
+    res = run_campaign("drift:serve.spmv_scan.blocked:0.001:1",
+                       backend="inproc", mix="spmv", requests=6, seed=5)
+    assert res.ok, [v.as_dict() for v in res.violations]
+
+
+def test_run_campaigns_orchestration(tmp_path):
+    out = run_campaigns(seed=2, campaigns=2, backend="inproc",
+                        mix="cipher", requests=6,
+                        bank_dir=str(tmp_path))
+    assert len(out["campaigns"]) == 2
+    assert out["ok"] == (out["violations_total"] == 0)
+    # every drawn cocktail validated and is recorded verbatim
+    for c in out["campaigns"]:
+        assert validate_cocktail(
+            FaultPlan.parse(c["cocktail"]), "inproc") == []
+    assert json.loads(json.dumps(out)) == out   # JSON-clean report
+
+
+# --------------------------------------------------- banked fixtures
+
+@pytest.mark.parametrize("path", FIXTURES,
+                         ids=[os.path.basename(p) for p in FIXTURES])
+def test_banked_fixture_replays(path):
+    result, expected, observed = replay_fixture(path)
+    assert observed == expected, \
+        f"{os.path.basename(path)}: {result.violations}"
+
+
+def test_fixture_bank_is_not_empty():
+    # the bank must always hold at least one passing fixture and one
+    # violation fixture: the replay test proves both directions
+    docs = [json.load(open(p)) for p in FIXTURES]
+    assert any(d["expect"]["violated"] == [] for d in docs)
+    assert any(d["expect"]["violated"] for d in docs)
+    for d in docs:
+        assert FaultPlan.parse(d["minimal_cocktail"]).clauses
+
+
+# ------------------------------------------------------------ CLI
+
+def test_chaos_cli_draw_deterministic(capsys):
+    from cme213_tpu.chaos_cli import main
+
+    assert main(["draw", "--seed", "3", "--campaigns", "3",
+                 "--mix", "cipher,sort"]) == 0
+    first = capsys.readouterr().out
+    assert main(["draw", "--seed", "3", "--campaigns", "3",
+                 "--mix", "cipher,sort"]) == 0
+    assert capsys.readouterr().out == first
+    assert len(first.strip().splitlines()) == 3
+
+
+def test_chaos_cli_matrix_and_help(capsys):
+    from cme213_tpu.chaos_cli import main
+
+    assert main(["matrix", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc) == set(MATRIX)
+    assert main(["--help"]) == 0
+    assert main(["no-such"]) == 2
+
+
+# --------------------------------------------------- live-fleet campaign
+
+@pytest.mark.slow
+def test_fleet_campaign_with_replica_kill():
+    # the full game day: a replica SIGKILLed mid-batch while another
+    # clause fails a rung — zero accepted-request loss, bitwise
+    # conformance, one trace id across the gang, nothing leaked
+    res = run_campaign(
+        "replica-kill:0:2,fail:serve.cipher.packed:1:1",
+        backend="fleet", mix="cipher,sort", requests=12, seed=6,
+        replicas=2)
+    assert res.ok, [v.as_dict() for v in res.violations]
+    assert res.report["served"] == 12

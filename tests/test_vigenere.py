@@ -120,7 +120,7 @@ def test_workload_registry():
     assert set(WORKLOADS) == {"cipher", "pagerank", "heat2d", "vigenere",
                               "sorts", "spmv_scan", "trace", "serve",
                               "tune", "doctor", "collect", "top",
-                              "numerics", "fleet"}
+                              "numerics", "fleet", "chaos"}
     assert dispatch(["--help"]) == 0
     assert dispatch(["no-such-workload"]) == 2
     for w in WORKLOADS.values():
