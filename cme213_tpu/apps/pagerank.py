@@ -61,6 +61,60 @@ def run_pagerank(graph: Graph, nr_iterations: int, timer: PhaseTimer | None = No
     return out
 
 
+def pagerank_step(graph: Graph):
+    """``(state0, step_fn)`` for the checkpointed/long-job lane:
+    ``step_fn(rank, k)`` advances the rank vector by ``k`` propagate
+    sweeps.  Even ``k`` rides :func:`~..ops.gather.pagerank_iterate`
+    (the reference's fused even-iteration loop, pagerank.cu:61,127);
+    odd ``k`` — possible only after a RESOURCE chunk-halving — falls
+    back to per-sweep :func:`~..ops.gather.pagerank_propagate` calls,
+    the same program one iteration at a time."""
+    from ..ops.gather import pagerank_propagate
+
+    indices = jnp.asarray(graph.indices)
+    edges = jnp.asarray(graph.edges.astype(np.int32))
+    row_ids = csr_row_ids(indices, graph.edges.shape[0])
+    inv_deg = jnp.asarray(graph.inv_deg)
+
+    def step_fn(state, k):
+        rank = jnp.asarray(state)
+        k = int(k)
+        if k >= 2 and k % 2 == 0:
+            return pagerank_iterate(row_ids, edges, rank, inv_deg,
+                                    graph.num_nodes, k)
+        for _ in range(k):
+            rank = pagerank_propagate(row_ids, edges, rank, inv_deg,
+                                      graph.num_nodes)
+        return rank
+
+    return graph.rank0, step_fn
+
+
+def run_pagerank_checkpointed(graph: Graph, nr_iterations: int, path: str,
+                              every: int = 0, tracker=None,
+                              stall_epochs: int = 25) -> np.ndarray:
+    """Checkpointed PageRank: the power iteration in epoch-sized chunks
+    through ``core.checkpoint.run_with_checkpoints``, resuming from
+    ``path`` when a checkpoint exists.  Each accepted chunk feeds a
+    ``ConvergenceTracker`` (one ``solver-progress`` event per epoch:
+    residual, delta-norm, iters/s — the convergence trace the
+    interactive driver above never emitted), with ``stall_epochs``
+    registered on the tracker so a flatlined solve is called STALLED
+    instead of burning its whole budget.  Chunking is arithmetic-neutral
+    (every iteration runs the same propagate program), so the final
+    ranks are bitwise-equal to an uninterrupted :func:`run_pagerank` of
+    the same even iteration count."""
+    from ..core.checkpoint import run_with_checkpoints
+    from ..core.numerics import ConvergenceTracker
+
+    if tracker is None:
+        tracker = ConvergenceTracker("pagerank", stall_epochs=stall_epochs)
+    state0, step_fn = pagerank_step(graph)
+    out = run_with_checkpoints(step_fn, state0, nr_iterations, path,
+                               every=every, op="pagerank", tracker=tracker)
+    return np.asarray(out)
+
+
 def bytes_moved(graph: Graph, nr_iterations: int) -> int:
     """Exact byte accounting for bandwidth reports — delegates to the
     centralized cost model (``core/roofline.pagerank_cost``), as

@@ -72,10 +72,10 @@ from . import faults
 from .faults import FaultPlan, _Clause
 
 #: invariant names, in report order
-INVARIANTS = ("loss", "conformance", "slo_report", "trace", "leaks")
+INVARIANTS = ("loss", "conformance", "slo_report", "trace", "leaks", "job")
 
 #: recognized game-day handicaps (deliberate breakages for drills)
-HANDICAPS = ("drift-compensation",)
+HANDICAPS = ("drift-compensation", "ckpt-retry")
 
 
 # --------------------------------------------------------------- topology
@@ -94,6 +94,21 @@ TOPOLOGY: dict[str, dict] = {
     "sort": {"rungs": ("lax", "radix", "bitonic"), "float": False,
              "probe_ops": ("serve.sort",)},
     "stub": {"rungs": ("echo",), "float": False, "probe_ops": ()},
+    # job-lane entries (``"job": True``) are NOT serving adapters: they
+    # name registered long-job kinds (serve/workloads.JOB_KINDS) a
+    # campaign can run in the idle gaps via ``run_campaign(job=...)``.
+    # Their presence in a campaign's op set is what makes ``ckpt:``
+    # clauses drawable — the job lane's durable writers are the only
+    # checkpoint path a serving campaign exercises.
+    "pagerank": {"rungs": ("power",), "float": True, "probe_ops": (),
+                 "job": True},
+}
+
+#: the job-lane campaign shape: small enough that a banked fixture
+#: replays inside tier-1, large enough for several durable epochs
+JOB_PARAMS: dict[str, dict] = {
+    "pagerank": {"nodes": 128, "avg_edges": 4, "iters": 12, "epoch": 4,
+                 "seed": 3},
 }
 
 #: loadgen ``--mix`` names -> adapter op names
@@ -168,9 +183,14 @@ MATRIX: dict[str, KindRule] = {r.kind: r for r in (
              reason="maybe_kill_rank guards gang-solver epoch steps "
                     "(dist/launch.py); serving replicas are killed via "
                     "replica-kill instead"),
-    KindRule("ckpt", False,
-             reason="checkpoint writers (truncate/commit windows) are "
-                    "not on the serving path — inert"),
+    KindRule("ckpt", True, ("inproc",), max_per_cocktail=2,
+             reason="drawable only when the campaign runs a long job "
+                    "(run_campaign(job=...)): the job lane's durable "
+                    "writers (epoch checkpoints, record publishes) are "
+                    "the serving tier's only checkpoint path.  inproc "
+                    "only — the guards fire in the campaign runner's "
+                    "own executor, so the invariant checker sees the "
+                    "same store the clause corrupted"),
     KindRule("unreachable", False,
              reason="the op-agnostic device preflight is consulted at "
                     "replica startup and by the doctor; an unreachable "
@@ -185,8 +205,11 @@ def clause_targets(backend: str, ops: list[str],
     over ``ops`` (adapter names).  Pure function of its inputs — the
     same campaign shape always offers the same pool."""
     pool: dict[str, list[dict]] = {}
+    job_ops = [op for op in ops if TOPOLOGY[op].get("job")]
     for op in ops:
         topo = TOPOLOGY[op]
+        if topo.get("job"):
+            continue                    # not a serving adapter
         rungs = topo["rungs"]
         for rung in rungs[:-1]:         # never the terminal rung
             pool.setdefault("fail", []).append(
@@ -204,6 +227,12 @@ def clause_targets(backend: str, ops: list[str],
     if backend == "fleet":
         for rank in range(replicas):
             pool.setdefault("replica-kill", []).append({"op": str(rank)})
+    if job_ops:
+        # both durable-writer crash windows: a torn epoch checkpoint
+        # (quarantine + .prev fallback) and a lost record publish
+        # (write-ahead intent replay)
+        pool.setdefault("ckpt", []).append({"op": "truncate"})
+        pool.setdefault("ckpt", []).append({"op": "commit"})
     return {k: v for k, v in pool.items()
             if MATRIX[k].eligible and backend in MATRIX[k].backends}
 
@@ -288,6 +317,9 @@ def draw_cocktail(rng: np.random.Generator, backend: str,
                            nth=1, count=1 << 30)
         elif kind == "wrong":
             cand = _Clause("wrong", tgt["op"], nth=1)
+        elif kind == "ckpt":
+            cand = _Clause("ckpt", tgt["op"],
+                           nth=int(rng.integers(1, 3)))
         else:                           # replica-kill
             cand = _Clause("replica-kill", tgt["op"],
                            nth=int(rng.integers(1, 3)))
@@ -321,6 +353,7 @@ class CampaignResult:
     requests: int
     replicas: int
     cocktail: str
+    job: str | None = None
     report: dict = field(default_factory=dict)
     violations: list = field(default_factory=list)
     elapsed_s: float = 0.0
@@ -334,7 +367,7 @@ class CampaignResult:
             "seed": self.seed, "campaign": self.index,
             "backend": self.backend, "mix": self.mix,
             "requests": self.requests, "replicas": self.replicas,
-            "cocktail": self.cocktail, "ok": self.ok,
+            "cocktail": self.cocktail, "job": self.job, "ok": self.ok,
             "violations": [v.as_dict() for v in self.violations],
             "elapsed_s": round(self.elapsed_s, 3),
             "report": self.report,
@@ -442,6 +475,62 @@ def check_trace(trace_ids: set, expected: str) -> list[Violation]:
                  f"{sorted(ids)!r}")]
 
 
+def _job_reference(op: str, params: dict):
+    """Disarmed re-run of the campaign's long job in a fresh store —
+    the value the armed run's durable result must equal bitwise."""
+    import tempfile
+
+    from ..serve import jobs as jobs_mod
+
+    prev = faults.active()
+    faults.install_plan(FaultPlan([]))
+    try:
+        store = jobs_mod.JobStore(tempfile.mkdtemp(prefix="chaos-jobref-"))
+        jobs_mod.submit_job(store, "ref", op, params)
+        ex = jobs_mod.JobExecutor(store, rank="ref")
+        for _ in range(500):
+            if not ex.tick():
+                break
+        return store.load_result("ref")
+    finally:
+        if prev is None:
+            faults.reset()
+        else:
+            faults.install_plan(prev)
+
+
+def check_job(job_ctx) -> list[Violation]:
+    """Invariant 6 (job campaigns): the long job survived the cocktail —
+    terminal state is DONE, no committed epoch was ever re-executed
+    (``job-epoch`` publishes carry unique epoch numbers), and the durable
+    result equals a disarmed re-run bitwise."""
+    from ..serve import jobs as jobs_mod
+    from . import trace
+
+    store, jid, op, params = job_ctx
+    rec = store.load(jid)
+    if rec is None:
+        return [Violation("job", f"job {jid}: record unreadable")]
+    if rec["state"] != jobs_mod.DONE:
+        return [Violation(
+            "job", f"job {jid} ended {rec['state']} "
+                   f"(reason {rec.get('reason')!r}) under the cocktail")]
+    out = []
+    epochs = [e["epoch"] for e in trace.events("job-epoch")
+              if e.get("job") == jid]
+    dupes = sorted({n for n in epochs if epochs.count(n) > 1})
+    if dupes:
+        out.append(Violation(
+            "job", f"job {jid}: committed epoch(s) {dupes} re-executed"))
+    got = store.load_result(jid)
+    ref = _job_reference(op, params)
+    if got is None or ref is None or _bits(got) != _bits(ref):
+        out.append(Violation(
+            "job", f"job {jid}: durable result != disarmed re-run "
+                   f"(bitwise)"))
+    return out
+
+
 def _shm_segments() -> set:
     try:
         return {n for n in os.listdir("/dev/shm") if n.startswith("psm_")}
@@ -477,9 +566,18 @@ def _campaign_hygiene() -> None:
 
 
 def _run_inproc(plan: FaultPlan, mix: str, requests: int, seed: int,
-                max_batch: int, concurrency: int = 6):
+                max_batch: int, concurrency: int = 6,
+                job: str | None = None,
+                handicaps: tuple[str, ...] = ()):
     """Drive an in-process Server under the armed cocktail; returns
-    (pairs, report, trace_ids, shm_before, live_procs)."""
+    (pairs, report, trace_ids, shm_before, live_procs, job_ctx).  With
+    ``job``, a long job runs in the serving gaps exactly as a replica
+    would run it — submitted before the load, ticked between service
+    steps (so queue-depth preemption has something to preempt), then
+    driven to a terminal state after the interactive load drains."""
+    import tempfile
+
+    from ..serve import jobs as jobs_mod
     from ..serve.loadgen import build_mix, slo_report
     from ..serve.server import Server
     from . import metrics, trace
@@ -492,7 +590,19 @@ def _run_inproc(plan: FaultPlan, mix: str, requests: int, seed: int,
     faults.install_plan(plan.reset_counters())
     t0 = time.monotonic()
     pairs = []
+    job_ctx = None
+    executor = None
     try:
+        if job:
+            params = dict(JOB_PARAMS[job])
+            jstore = jobs_mod.JobStore(
+                tempfile.mkdtemp(prefix="chaos-job-"))
+            jid = f"chaos-{seed}"
+            jobs_mod.submit_job(jstore, jid, job, params)
+            executor = jobs_mod.JobExecutor(
+                jstore, server=server, rank="chaos",
+                commit_retries=0 if "ckpt-retry" in handicaps else 3)
+            job_ctx = (jstore, jid, job, params)
         pending = list(specs)
         inflight: dict[int, object] = {}
         while pending or inflight:
@@ -507,6 +617,15 @@ def _run_inproc(plan: FaultPlan, mix: str, requests: int, seed: int,
                     pairs.append((spec, out))    # shed at submit
             for res in server.step():
                 pairs.append((inflight.pop(res.rid), res))
+            if executor is not None:
+                executor.tick()
+        if executor is not None:
+            # interactive load drained: the job owns the idle gaps now
+            for _ in range(500):
+                rec = job_ctx[0].load(job_ctx[1])
+                if rec is None or rec["state"] in jobs_mod.TERMINAL:
+                    break
+                executor.tick()
     finally:
         if prev is None:
             faults.reset()
@@ -516,7 +635,7 @@ def _run_inproc(plan: FaultPlan, mix: str, requests: int, seed: int,
     run = {"results": [r for _, r in pairs], "elapsed_s": elapsed}
     report = slo_report(run, before, metrics.snapshot())
     trace_ids = {e.get("trace") for e in trace.events()}
-    return pairs, report, trace_ids, shm_before, []
+    return pairs, report, trace_ids, shm_before, [], job_ctx
 
 
 def _run_fleet(plan: FaultPlan, mix: str, requests: int, seed: int,
@@ -628,9 +747,14 @@ def run_campaign(cocktail: FaultPlan | str, backend: str = "inproc",
                  mix: str = "cipher,sort", requests: int = 12,
                  seed: int = 0, index: int = 0, replicas: int = 2,
                  max_batch: int = 4,
-                 handicaps: tuple[str, ...] = ()) -> CampaignResult:
-    """Arm ``cocktail``, drive one serving run, disarm, check the five
-    global invariants.  Deterministic for a deterministic cocktail."""
+                 handicaps: tuple[str, ...] = (),
+                 job: str | None = None) -> CampaignResult:
+    """Arm ``cocktail``, drive one serving run, disarm, check the global
+    invariants.  Deterministic for a deterministic cocktail.  ``job``
+    names a registered long-job kind to run in the serving gaps; job
+    campaigns add invariant 6 (the job reaches DONE with no committed
+    epoch re-executed and a bitwise-reference result)."""
+    from ..serve.workloads import JOB_KINDS
     from . import trace
 
     plan = (FaultPlan.parse(cocktail) if isinstance(cocktail, str)
@@ -640,19 +764,32 @@ def run_campaign(cocktail: FaultPlan | str, backend: str = "inproc",
             raise ValueError(f"unknown handicap {h!r} (know {HANDICAPS})")
     if backend not in ("inproc", "fleet"):
         raise ValueError(f"unknown backend {backend!r} (inproc | fleet)")
+    if job is not None:
+        if job not in JOB_KINDS or job not in JOB_PARAMS:
+            raise ValueError(f"unknown job kind {job!r}")
+        if backend != "inproc":
+            raise ValueError(
+                "job campaigns are inproc-only (the fleet job lane is "
+                "exercised end to end by the CI job-lane gate instead)")
     for c in plan.clauses:
         if backend == "inproc" and c.kind in ("replica-kill", "rankkill"):
             raise ValueError(
                 f"{c.kind} clause in an in-process campaign would kill "
                 f"the runner itself; use backend='fleet'")
+        if c.kind == "ckpt" and job is None:
+            raise ValueError(
+                "ckpt clauses need a job campaign (run_campaign(job=...)) "
+                "— the job lane is the only checkpoint path here")
     _campaign_hygiene()
     record_kw = dict(seed=seed, campaign=index, cocktail=str(plan),
                      backend=backend)
     trace.record_event("chaos-campaign", **record_kw)
     t0 = time.monotonic()
+    job_ctx = None
     if backend == "inproc":
-        pairs, report, trace_ids, shm_before, live = _run_inproc(
-            plan, mix, requests, seed, max_batch)
+        pairs, report, trace_ids, shm_before, live, job_ctx = _run_inproc(
+            plan, mix, requests, seed, max_batch, job=job,
+            handicaps=handicaps)
     else:
         pairs, report, trace_ids, shm_before, live = _run_fleet(
             plan, mix, requests, seed, max_batch, replicas)
@@ -662,13 +799,15 @@ def run_campaign(cocktail: FaultPlan | str, backend: str = "inproc",
     violations += check_slo_report(report)
     violations += check_trace(trace_ids, report.get("trace_id"))
     violations += check_leaks(shm_before, live)
+    if job_ctx is not None:
+        violations += check_job(job_ctx)
     for v in violations:
         trace.record_event("chaos-violation", campaign=index,
                            invariant=v.invariant, detail=v.detail)
     return CampaignResult(
         seed=seed, index=index, backend=backend, mix=mix,
         requests=requests, replicas=replicas, cocktail=str(plan),
-        report=report, violations=violations,
+        job=job, report=report, violations=violations,
         elapsed_s=time.monotonic() - t0)
 
 
@@ -765,6 +904,7 @@ def bank_fixture(result: CampaignResult, minimal: FaultPlan,
         "cocktail": result.cocktail,
         "minimal_cocktail": str(minimal),
         "handicaps": list(handicaps),
+        "job": result.job,
         "expect": {"violated": sorted({v.invariant
                                        for v in result.violations})},
     }
@@ -788,7 +928,8 @@ def replay_fixture(path: str) -> tuple[CampaignResult, list[str], list[str]]:
         seed=int(doc["seed"]), index=int(doc["campaign"]),
         replicas=int(doc.get("replicas", 2)),
         max_batch=int(doc.get("max_batch", 4)),
-        handicaps=tuple(doc.get("handicaps", ())))
+        handicaps=tuple(doc.get("handicaps", ())),
+        job=doc.get("job"))
     expected = sorted(doc.get("expect", {}).get("violated", []))
     observed = sorted({v.invariant for v in result.violations})
     return result, expected, observed
@@ -801,16 +942,21 @@ def run_campaigns(seed: int, campaigns: int, backend: str = "inproc",
                   replicas: int = 2, max_batch: int = 4,
                   shrink_violations: bool = True,
                   bank_dir: str | None = None,
-                  handicaps: tuple[str, ...] = ()) -> dict:
+                  handicaps: tuple[str, ...] = (),
+                  job: str | None = None) -> dict:
     """The game day: ``campaigns`` seeded draws, each armed against a
     live run and invariant-checked; violations are ddmin-shrunk and
-    banked as fixtures.  Returns the campaign report (JSON-ready)."""
+    banked as fixtures.  Returns the campaign report (JSON-ready).
+    ``job`` adds a long-job kind to every campaign (and its ``ckpt:``
+    targets to the drawable pool)."""
     from . import trace
 
     ops = sorted({MIX_TO_OP[m.strip()] for m in mix.split(",")
                   if m.strip()})
+    if job:
+        ops.append(job)
     out: dict = {"seed": seed, "backend": backend, "mix": mix,
-                 "campaigns": [], "fixtures": []}
+                 "job": job, "campaigns": [], "fixtures": []}
     for i in range(campaigns):
         rng = np.random.default_rng([seed, i])
         plan = draw_cocktail(rng, backend, ops, replicas)
@@ -820,14 +966,14 @@ def run_campaigns(seed: int, campaigns: int, backend: str = "inproc",
         result = run_campaign(
             plan, backend=backend, mix=mix, requests=requests,
             seed=seed * 1000 + i, index=i, replicas=replicas,
-            max_batch=max_batch, handicaps=handicaps)
+            max_batch=max_batch, handicaps=handicaps, job=job)
         out["campaigns"].append(result.as_dict())
         if result.violations and shrink_violations:
             def failing(p: FaultPlan) -> bool:
                 r = run_campaign(
                     p, backend=backend, mix=mix, requests=requests,
                     seed=seed * 1000 + i, index=i, replicas=replicas,
-                    max_batch=max_batch, handicaps=handicaps)
+                    max_batch=max_batch, handicaps=handicaps, job=job)
                 return bool(r.violations)
 
             minimal = shrink(FaultPlan.parse(result.cocktail), failing)

@@ -139,6 +139,8 @@ class Collector:
         #: STALLED verdict `top` renders; same policy as
         #: core/numerics.ConvergenceTracker's default)
         self.solvers: dict[str, dict] = {}
+        #: durable long-job rows from job-* lifecycle events (serve/jobs.py)
+        self.jobs: dict[str, dict] = {}
         self.recent: collections.deque = collections.deque(maxlen=64)
         self.last_commit: dict | None = None
         self.last_rc = None
@@ -272,6 +274,9 @@ class Collector:
             self.fleet["sentinel_trips"] += 1
         elif event == "solver-progress":
             self._ingest_progress(rec)
+        elif event in ("job-submitted", "job-epoch", "job-preempted",
+                       "job-resumed", "job-done", "job-reassigned"):
+            self._ingest_job(event, rec)
         elif event == "served" and rec.get("demoted"):
             row["degraded"] = True
         elif event == "flight-dump":
@@ -300,7 +305,11 @@ class Collector:
     _MIN_IMPROVE = 1e-3
 
     def _ingest_progress(self, rec: dict) -> None:
+        # keyed by (op, job): two concurrent jobs running the same op
+        # must not fold into one convergence row
         op = str(rec.get("op") or "solver")
+        if rec.get("job"):
+            op = f"{op}[{rec['job']}]"
         res = rec.get("residual")
         if not isinstance(res, (int, float)):
             return
@@ -317,6 +326,39 @@ class Collector:
         else:
             row["since_improve"] += 1
         row["stalled"] = row["since_improve"] >= self._STALL_EPOCHS
+
+    def _ingest_job(self, event: str, rec: dict) -> None:
+        jid = str(rec.get("job") or "?")
+        row = self.jobs.setdefault(jid, {
+            "op": rec.get("op"), "state": None, "epoch": None,
+            "total_epochs": None, "residual": None, "epochs_seen": 0,
+            "resumes": 0, "preemptions": 0, "reassigned": 0,
+            "owner": None, "last_t": rec.get("t")})
+        row["last_t"] = rec.get("t")
+        if event == "job-submitted":
+            row.update(op=rec.get("op"), state="PENDING",
+                       total_epochs=rec.get("total_epochs"))
+            self.fleet["jobs_submitted"] += 1
+        elif event == "job-epoch":
+            row.update(state="RUNNING", epoch=rec.get("epoch"),
+                       residual=rec.get("residual"))
+            row["epochs_seen"] += 1
+            self.fleet["job_epochs"] += 1
+        elif event == "job-preempted":
+            row.update(state="PREEMPTED", epoch=rec.get("epoch"))
+            row["preemptions"] += 1
+            self.fleet["job_preemptions"] += 1
+        elif event == "job-resumed":
+            row.update(state="RUNNING", epoch=rec.get("epoch"))
+            row["resumes"] += 1
+            self.fleet["job_resumes"] += 1
+        elif event == "job-reassigned":
+            row["reassigned"] += 1
+            row["owner"] = rec.get("target")
+            self.fleet["job_reassignments"] += 1
+        else:                            # job-done
+            row.update(state=rec.get("state"))
+            self.fleet["jobs_done"] += 1
 
     # ------------------------------------------------------------- output
 
@@ -346,6 +388,7 @@ class Collector:
             "fleet": dict(sorted(self.fleet.items())),
             "verdicts": list(self.verdicts),
             "solvers": {k: dict(v) for k, v in sorted(self.solvers.items())},
+            "jobs": {k: dict(v) for k, v in sorted(self.jobs.items())},
             "spans": {k: dict(v) for k, v in sorted(self.spans.items())},
             "recent": list(self.recent),
             "last_rc": self.last_rc,

@@ -357,14 +357,19 @@ class ConvergenceTracker:
     verdict — the residual failing to improve by ``min_improve``
     (relative) for ``stall_epochs`` consecutive steps.  The checkpointed
     and supervised solve loops feed it; ``trace summary`` and ``top``
-    read the events back."""
+    read the events back.  ``job`` tags every event with a long-job id
+    (``serve/jobs.py``) so two concurrent jobs of the same op stay
+    distinct rows in the summary; None (the default) means the solve is
+    not a job."""
 
     def __init__(self, op: str, stall_epochs: int = 5,
-                 min_improve: float = 1e-3):
+                 min_improve: float = 1e-3, job: str | None = None):
         self.op = op
         self.stall_epochs = max(1, stall_epochs)
         self.min_improve = min_improve
+        self.job = job
         self.best: float | None = None
+        self.last_residual: float | None = None
         self.since_improve = 0
         self.steps = 0
 
@@ -374,10 +379,12 @@ class ConvergenceTracker:
         stall detector."""
         self.steps += 1
         residual = float(residual)
+        self.last_residual = residual
         record_event("solver-progress", op=self.op, step=int(step),
                      residual=round(residual, 9),
                      delta_norm=round(float(delta_norm), 9),
-                     iters_per_s=round(float(iters_per_s), 3))
+                     iters_per_s=round(float(iters_per_s), 3),
+                     job=self.job)
         metrics.counter("numerics.progress").inc()
         metrics.gauge(f"numerics.residual.{self.op}").set(round(residual, 9))
         if (self.best is None

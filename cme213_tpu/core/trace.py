@@ -168,10 +168,21 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
                       "over_budget"),
     "numeric-sentinel": ("op", "rung", "kind", "count", "size"),
     "solver-progress": ("op", "step", "residual", "delta_norm",
-                        "iters_per_s"),
+                        "iters_per_s", "job"),
     "drift-budget-burn": ("op", "rung", "burn_short", "burn_long",
                           "threshold"),
     "drift-budget-ok": ("op", "rung", "burn_short"),
+    # durable long-job lane (serve/jobs.py): one per accepted submit,
+    # one per committed epoch (emitted only after the record publish —
+    # epoch numbers are unique per job across crashes by construction),
+    # one per epoch-boundary preemption, one per resume (preempted /
+    # crash / restart), one per terminal transition
+    "job-submitted": ("job", "op", "total_epochs"),
+    "job-epoch": ("job", "op", "epoch", "residual"),
+    "job-preempted": ("job", "op", "epoch", "reason"),
+    "job-resumed": ("job", "op", "epoch", "source"),
+    "job-done": ("job", "op", "state", "epochs"),
+    "job-reassigned": ("job", "source", "target"),
     # game-day chaos campaigns (core/chaos.py): one per campaign run,
     # one per invariant violation, one per completed ddmin shrink
     "chaos-campaign": ("seed", "campaign", "cocktail", "backend"),

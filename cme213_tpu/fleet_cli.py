@@ -53,6 +53,9 @@ def _up_main(argv: list[str]) -> int:
     ap.add_argument("--slo-p99-ms", type=float, default=None,
                     help="p99 objective feeding the autoscaler's burn "
                     "signal")
+    ap.add_argument("--jobs-dir", default=None,
+                    help="durable long-job directory shared with every "
+                    "replica (arms the preemptible job lane)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="print the final fleet stats as JSON on exit")
     args = ap.parse_args(argv)
@@ -84,7 +87,8 @@ def _up_main(argv: list[str]) -> int:
                   warm_requests=args.warm_requests,
                   dispatch_width=args.dispatch_width,
                   port=args.port, ready_timeout_s=args.ready_timeout_s,
-                  slo=slo, autoscaler=autoscaler, clock=clock)
+                  slo=slo, autoscaler=autoscaler, clock=clock,
+                  jobs_dir=args.jobs_dir)
     try:
         fleet.start()
     except TimeoutError as e:
@@ -114,13 +118,118 @@ def _up_main(argv: list[str]) -> int:
     return 0
 
 
+def _jobs_main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleet jobs",
+        description="submit/inspect durable long jobs on a running fleet "
+                    "(or single replica) over its control channel")
+    ap.add_argument("verb",
+                    choices=("submit", "status", "list", "cancel",
+                             "result", "wait"))
+    ap.add_argument("--addr", required=True,
+                    help="front-end (or replica) host:port")
+    ap.add_argument("--job", default=None,
+                    help="client-chosen job id (idempotency key)")
+    ap.add_argument("--op", default="pagerank",
+                    help="registered job kind (serve/workloads.JOB_KINDS)")
+    ap.add_argument("--param", action="append", default=[],
+                    metavar="K=V",
+                    help="job parameter override, repeatable "
+                    "(e.g. --param nodes=8192 --param iters=96)")
+    ap.add_argument("--wait-s", type=float, default=120.0,
+                    help="wait: give up after this many seconds")
+    ap.add_argument("--out", default=None,
+                    help="result: write the array here as .npy")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    from .serve import wire
+    from .serve.transport import TransportClient
+
+    if args.verb != "list" and not args.job:
+        print("fleet jobs: --job is required", file=sys.stderr)
+        return 2
+    params = {}
+    for kv in args.param:
+        k, sep, v = kv.partition("=")
+        if not sep:
+            print(f"fleet jobs: bad --param {kv!r} (want K=V)",
+                  file=sys.stderr)
+            return 2
+        params[k] = v
+
+    def show(doc: dict) -> None:
+        if args.as_json:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        else:
+            job = doc.get("job")
+            if isinstance(job, dict):
+                print(f"job {job['job']}: {job['state']} "
+                      f"epoch {job['epoch']}/{job['total_epochs']} "
+                      f"iters {job['iters']}/{job['total_iters']} "
+                      f"residual {job.get('residual')} "
+                      f"resumes {job['resumes']} "
+                      f"preemptions {job['preemptions']}")
+            else:
+                print(json.dumps(doc, sort_keys=True))
+
+    with TransportClient(args.addr, timeout_s=30.0) as c:
+        if args.verb == "submit":
+            reply = c.control("job-submit", job=args.job, op=args.op,
+                              params=params)
+        elif args.verb == "status":
+            reply = c.control("job-status", job=args.job)
+        elif args.verb == "list":
+            reply = c.control("job-list")
+            if reply.get("ok") and not args.as_json:
+                for job in reply.get("jobs", []):
+                    print(f"{job['job']:24s} {job['op']:10s} "
+                          f"{job['state']:10s} "
+                          f"epoch {job['epoch']}/{job['total_epochs']}")
+                return 0
+        elif args.verb == "cancel":
+            reply = c.control("job-cancel", job=args.job)
+        elif args.verb == "wait":
+            deadline = time.monotonic() + args.wait_s
+            reply = {"ok": False, "error": "wait timeout"}
+            while time.monotonic() < deadline:
+                reply = c.control("job-status", job=args.job)
+                state = (reply.get("job") or {}).get("state")
+                if state in ("DONE", "FAILED", "STALLED"):
+                    break
+                time.sleep(0.25)
+            else:
+                show(reply)
+                return 1
+        else:  # result
+            reply = c.control("job-result", job=args.job)
+            if reply.get("ok"):
+                value = wire.nd_b64_decode(reply.pop("value"))
+                if args.out:
+                    import numpy as np
+
+                    np.save(args.out, value)
+                    reply["saved"] = args.out
+                reply["shape"] = list(value.shape)
+                reply["dtype"] = str(value.dtype)
+    show(reply)
+    if not reply.get("ok"):
+        return 1
+    if args.verb == "wait":
+        return 0 if (reply.get("job") or {}).get("state") == "DONE" else 1
+    return 0
+
+
 def main(argv: list[str]) -> int:
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m cme213_tpu fleet <up|worker> [args...]\n\n"
+        print("usage: python -m cme213_tpu fleet <up|worker|jobs> "
+              "[args...]\n\n"
               "subcommands:\n"
               "  up      spawn N supervised server replicas behind a "
               "tenant-fair socket front end\n"
-              "  worker  one replica process (spawned by `up`)")
+              "  worker  one replica process (spawned by `up`)\n"
+              "  jobs    submit/inspect durable long jobs on a running "
+              "fleet (submit|status|list|cancel|result|wait)")
         return 0 if argv else 2
     if argv[0] == "up":
         return _up_main(argv[1:])
@@ -128,7 +237,9 @@ def main(argv: list[str]) -> int:
         from .serve.fleet import worker_main
 
         return worker_main(argv[1:])
-    print(f"fleet: unknown subcommand {argv[0]!r} (try up | worker)",
+    if argv[0] == "jobs":
+        return _jobs_main(argv[1:])
+    print(f"fleet: unknown subcommand {argv[0]!r} (try up | worker | jobs)",
           file=sys.stderr)
     return 2
 

@@ -75,6 +75,7 @@ from ..dist.launch import (
     free_port,
 )
 from ..dist.supervisor import HEARTBEAT_DIR_ENV, HEARTBEAT_INTERVAL_ENV
+from . import jobs as jobs_mod
 from . import wire
 from .request import FAILED, QUEUE_FULL, SHED
 from .router import Autoscaler, Router, Ticket
@@ -197,7 +198,8 @@ class Fleet:
                  max_restarts: int = 4,
                  slo=None, autoscaler: Autoscaler | None = None,
                  clock: Clock | None = None,
-                 router: Router | None = None, shm: bool = True):
+                 router: Router | None = None, shm: bool = True,
+                 jobs_dir: str | None = None):
         self.initial_replicas = replicas
         self.capacity = capacity
         self.max_batch = max_batch
@@ -227,6 +229,13 @@ class Fleet:
         self.scale_ups = 0
         self.scale_downs = 0
         self.flight_confirmed = 0      # requests confirmed mid-batch in dumps
+        # durable long-job lane: a shared job directory every replica
+        # mounts (serve/jobs.py).  The front end serves job-* controls
+        # against it directly; replicas claim and execute the records.
+        self.jobs_dir = (jobs_dir if jobs_dir is not None
+                         else os.environ.get(jobs_mod.JOBS_DIR_ENV))
+        self.jobs_store = (jobs_mod.JobStore(self.jobs_dir)
+                           if self.jobs_dir else None)
 
     # ------------------------------------------------------------ start
 
@@ -246,6 +255,7 @@ class Fleet:
             self.close()
             raise TimeoutError(
                 f"fleet: replicas not ready in {self.ready_timeout_s}s")
+        self._adopt_orphan_jobs()
         self.front.start()
         for name, fn in (("fleet-dispatch", self._dispatch_loop),
                          ("fleet-supervise", self._supervise_loop)):
@@ -268,6 +278,8 @@ class Fleet:
         env = dict(os.environ)
         env["JAX_PROCESS_ID"] = str(rank)
         env["CME213_INCARNATION"] = str(incarnation)
+        if self.jobs_dir:
+            env[jobs_mod.JOBS_DIR_ENV] = self.jobs_dir
         env.setdefault(HEARTBEAT_INTERVAL_ENV, "0.5")
         env.update(propagation_env())
         _template_trace_file(env, rank)
@@ -431,6 +443,43 @@ class Fleet:
         if relaunch:
             self._spawn(incarnation=rep.incarnation + 1,
                                rank=rep.rank)
+        elif self.jobs_store is not None:
+            # the dead replica is NOT coming back: move its claimed jobs
+            # to a live rank so they resume from their durable epoch
+            # rather than sitting orphaned until the next fleet restart.
+            with self._cv:
+                live = sorted(p.rank for p in self._procs.values()
+                              if p.state == "up" and p.rank != rep.rank)
+            if live:
+                moved = self.jobs_store.reassign_from(
+                    str(rep.rank), str(live[0]))
+                for jid in moved:
+                    record_event("job-reassigned", job=jid,
+                                 source=str(rep.rank), target=str(live[0]))
+                    metrics.counter("jobs.reassigned").inc()
+
+    def _adopt_orphan_jobs(self) -> None:
+        """Fleet restart: job records whose owner rank no longer exists
+        (the previous fleet's replicas are all gone) are reassigned to
+        the lowest live rank so they resume from their last durable
+        epoch."""
+        if self.jobs_store is None:
+            return
+        with self._cv:
+            ranks = {str(p.rank) for p in self._procs.values()
+                     if p.state == "up"}
+        if not ranks:
+            return
+        target = min(ranks, key=int)
+        for rec in self.jobs_store.list_jobs():
+            if rec["state"] in jobs_mod.TERMINAL:
+                continue
+            owner = self.jobs_store.owner(rec["job"])
+            if owner is not None and owner not in ranks:
+                self.jobs_store.reassign(rec["job"], target)
+                record_event("job-reassigned", job=rec["job"],
+                             source=owner, target=target)
+                metrics.counter("jobs.reassigned").inc()
 
     def _read_flight_dump(self, rep: ReplicaProc) -> int:
         """Post-mortem: from the dead replica's flight-recorder dump
@@ -586,8 +635,24 @@ class _FleetFrontEnd(FrameServer):
                     "reason": "transport-timeout", "tenant": ticket.tenant}
         return ticket.result
 
+    def control(self, doc: dict) -> dict:
+        kind = doc.get("control")
+        if isinstance(kind, str) and kind.startswith("job-"):
+            store = self.fleet.jobs_store
+            if store is None:
+                return {"ok": False,
+                        "error": "fleet has no --jobs-dir; job lane is off"}
+            return jobs_mod.handle_control(store, doc)
+        return super().control(doc)
+
     def stats(self) -> dict:
-        return self.fleet.stats()
+        out = self.fleet.stats()
+        if self.fleet.jobs_store is not None:
+            states: dict[str, int] = {}
+            for rec in self.fleet.jobs_store.list_jobs():
+                states[rec["state"]] = states.get(rec["state"], 0) + 1
+            out["jobs"] = states
+        return out
 
 
 # ------------------------------------------------------------ worker
@@ -622,6 +687,11 @@ def worker_main(argv: list[str]) -> int:
     server = Server(capacity=args.capacity, max_batch=args.max_batch)
     ts = TransportServer(server, port=args.port, drive="thread",
                          kill_guard=True)
+    jobs_dir = os.environ.get(jobs_mod.JOBS_DIR_ENV)
+    if jobs_dir:
+        store = jobs_mod.JobStore(jobs_dir)
+        ts.attach_jobs(jobs_mod.JobExecutor(store, server=server, rank=rank))
+        print(f"fleet worker r{rank}: job lane on {jobs_dir}", flush=True)
     ts.start()
     record_event("replica-up", replica=int(rank),
                  incarnation=incarnation(), addr=ts.addr)

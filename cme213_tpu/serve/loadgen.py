@@ -412,6 +412,63 @@ def compile_attribution(before: dict, after: dict) -> dict:
     }
 
 
+def submit_job_over(addr: str, args) -> dict:
+    """Submit the ``--job`` long job over the transport's control
+    channel before the interactive load starts (idempotent: a duplicate
+    submit adopts the existing record)."""
+    from .transport import TransportClient
+
+    params = {"nodes": args.job_nodes, "iters": args.job_iters,
+              "epoch": args.job_epoch}
+    with TransportClient(addr, timeout_s=10.0) as client:
+        reply = client.control("job-submit", job=args.job, op=args.job_op,
+                               params=params)
+    if not reply.get("ok"):
+        return {"submitted": False, "error": reply.get("error")}
+    return {"submitted": True, "created": reply.get("created"),
+            "job": reply.get("job")}
+
+
+def wait_job_over(addr: str, args, section: dict) -> dict:
+    """After the load pass: poll ``--job`` until it is terminal (or the
+    ``--job-wait-s`` budget runs out) and return the report section —
+    the durable record's final public view plus how it got there."""
+    import time as time_mod
+
+    from .transport import TransportClient
+
+    out = {"job": args.job, "op": args.job_op,
+           "submitted": section.get("submitted", False),
+           "created": section.get("created")}
+    if not section.get("submitted"):
+        out["state"] = None
+        out["error"] = section.get("error", "submit failed")
+        return out
+    deadline = time_mod.monotonic() + args.job_wait_s
+    rec = None
+    while time_mod.monotonic() < deadline:
+        try:
+            with TransportClient(addr, timeout_s=10.0) as client:
+                reply = client.control("job-status", job=args.job)
+        except (OSError, ConnectionError, ValueError):
+            time_mod.sleep(0.25)
+            continue
+        rec = reply.get("job") if reply.get("ok") else None
+        if rec and rec["state"] in ("DONE", "FAILED", "STALLED"):
+            break
+        time_mod.sleep(0.25)
+    if rec is None:
+        out["state"] = None
+        out["error"] = "status unavailable"
+        return out
+    out.update({k: rec.get(k) for k in
+                ("state", "epoch", "total_epochs", "iters", "total_iters",
+                 "residual", "resumes", "preemptions", "reason")})
+    if rec["state"] not in ("DONE", "FAILED", "STALLED"):
+        out["error"] = f"not terminal after {args.job_wait_s}s"
+    return out
+
+
 def _pcts(values) -> dict | None:
     """{p50, p99} by nearest rank, or None with no samples."""
     vals = sorted(v for v in values if v is not None)
@@ -646,6 +703,13 @@ def format_report(report: dict) -> str:
                 f"requeues {row.get('requeues', 0)}, "
                 f"breaker {row.get('breaker', '?')}"
                 f"{'' if row.get('up') else '  DOWN'}")
+    job = report.get("job")
+    if job:
+        lines.append(
+            f"job {job.get('job')}: {job.get('state')} "
+            f"(epoch {job.get('epoch')}/{job.get('total_epochs')}, "
+            f"{job.get('resumes', 0)} resume(s), "
+            f"{job.get('preemptions', 0)} preemption(s))")
     if "baseline" in report:
         b = report["baseline"]
         lines.append(f"baseline (max_batch=1): {b['throughput_rps']} req/s "
@@ -730,6 +794,20 @@ def main(argv: list[str]) -> int:
                     help="exit nonzero when client encode+decode p99 "
                     "exceeds this fraction of the p99 rtt (the framing-"
                     "overhead gate; needs --transport)")
+    ap.add_argument("--job", default=None, metavar="JOB_ID",
+                    help="with --transport: submit a durable long job "
+                    "before the interactive load and report its fate "
+                    "alongside the SLO report (needs a job lane — fleet "
+                    "up --jobs-dir)")
+    ap.add_argument("--job-op", default="pagerank",
+                    help="job kind for --job (serve/workloads.JOB_KINDS)")
+    ap.add_argument("--job-nodes", type=int, default=4096)
+    ap.add_argument("--job-iters", type=int, default=48)
+    ap.add_argument("--job-epoch", type=int, default=8,
+                    help="iterations per durable epoch for --job")
+    ap.add_argument("--job-wait-s", type=float, default=120.0,
+                    help="after the load pass, wait this long for --job "
+                    "to reach DONE (exit nonzero otherwise)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
@@ -753,6 +831,8 @@ def main(argv: list[str]) -> int:
                               poll_interval_s=0.001)).start()
             addr = own_server.addr
         try:
+            if args.job:
+                job_section = submit_job_over(addr, args)
             if args.warm:
                 run_load_transport(addr, specs, mode=args.mode,
                                    concurrency=args.concurrency,
@@ -767,12 +847,19 @@ def main(argv: list[str]) -> int:
             report = slo_report(run, before, after)
             report["transport"] = transport_section(run, before, after)
             report["fleet"] = fleet_section(run, addr)
+            if args.job:
+                report["job"] = wait_job_over(addr, args, job_section)
         finally:
             if own_server is not None:
                 own_server.close()
         print(json.dumps(report, indent=2) if args.as_json
               else format_report(report))
         rc = 0
+        if args.job and report["job"].get("state") != "DONE":
+            print(f"FAIL: job {args.job} is "
+                  f"{report['job'].get('state')!r}, not DONE "
+                  f"({report['job'].get('error')})", file=sys.stderr)
+            rc = 1
         rps = report["throughput_rps"]
         if args.min_rps is not None and (rps or 0) < args.min_rps:
             print(f"FAIL: {rps} req/s below --min-rps={args.min_rps}",

@@ -155,6 +155,9 @@ class Server:
         # waker so its background batcher thread wakes on arrival instead
         # of polling.  Called after every successful enqueue.
         self.on_submit = None
+        # the long-job lane (serve/jobs.py JobExecutor | None): driven by
+        # job_tick() strictly in the gaps between interactive batches
+        self.jobs = None
 
     # ------------------------------------------------------------ submit
 
@@ -272,6 +275,17 @@ class Server:
         while len(self.queue):
             results.extend(self.step())
         return results
+
+    def job_tick(self) -> bool:
+        """Run at most one long-job epoch through the attached executor
+        (``serve/jobs.py``).  Interactive traffic strictly wins: the
+        executor re-checks queue depth and SLO burn before every epoch
+        and preempts at the boundary, so the caller may tick whenever a
+        ``step()`` left the queue empty.  Returns True when durable job
+        progress was made (more work may remain)."""
+        if self.jobs is None:
+            return False
+        return self.jobs.tick()
 
     # ---------------------------------------------------------- internals
 

@@ -443,6 +443,14 @@ class TransportServer(FrameServer):
         self.batches = 0                     # batcher sweeps that executed
         server.on_submit = self._wake.set
 
+    def attach_jobs(self, executor) -> "TransportServer":
+        """Wire a ``serve.jobs.JobExecutor`` into this transport: the
+        batcher (or :meth:`pump`) ticks one job epoch per idle gap, and
+        ``job-*`` control frames are served against its store."""
+        executor.server = self.server
+        self.server.jobs = executor
+        return self
+
     def start(self) -> "TransportServer":
         super().start()
         if self.drive == "thread":
@@ -529,6 +537,23 @@ class TransportServer(FrameServer):
             self._wake.wait(self._poll_interval_s)
             self._wake.clear()
             self._sweep()
+            self._job_tick()
+
+    def _job_tick(self) -> None:
+        """One long-job epoch in this idle gap (outside ``_mu``: the
+        epoch runs while interactive submits keep landing, and the next
+        ``_sweep`` drains them the moment the epoch yields — epoch
+        boundaries ARE the preemption points).  Re-arms the wake event
+        while job work remains so back-to-back idle gaps keep the job
+        moving instead of waiting out the poll interval."""
+        if self.server.jobs is None or self._stop.is_set():
+            return
+        try:
+            if self.server.job_tick():
+                self._wake.set()
+        except Exception:             # noqa: BLE001 — never take down
+            # the batcher thread; the executor already FAILed the job
+            metrics.counter("jobs.tick_errors").inc()
 
     def _sweep(self) -> None:
         """Step until the queue is empty, delivering results."""
@@ -544,11 +569,13 @@ class TransportServer(FrameServer):
             self._send_v2(v2_out)
 
     def pump(self) -> list[SolveResult]:
-        """Caller-driven drive mode: one server step + delivery."""
+        """Caller-driven drive mode: one server step + delivery, then
+        (with a job lane attached) one job epoch if the gap is idle."""
         with self._mu:
             results = self.server.step()
             v2_out = self._deliver_locked(results)
         self._send_v2(v2_out)
+        self._job_tick()
         return results
 
     def _deliver_locked(self, results) -> list:
@@ -588,12 +615,26 @@ class TransportServer(FrameServer):
             except (ConnectionError, OSError):
                 pass                 # client went away; results dropped
 
+    def control(self, doc: dict) -> dict:
+        kind = doc.get("control")
+        if isinstance(kind, str) and kind.startswith("job-"):
+            from . import jobs as jobs_mod
+
+            if self.server.jobs is None:
+                return {"ok": False,
+                        "error": "no job lane on this server"}
+            return jobs_mod.handle_control(self.server.jobs.store, doc)
+        return super().control(doc)
+
     def stats(self) -> dict:
         with self._mu:
-            return {"queue_depth": len(self.server.queue),
-                    "pending": len(self._pending),
-                    "batches": self.batches,
-                    "degraded": self.server.degraded}
+            out = {"queue_depth": len(self.server.queue),
+                   "pending": len(self._pending),
+                   "batches": self.batches,
+                   "degraded": self.server.degraded}
+        if self.server.jobs is not None:
+            out["jobs"] = self.server.jobs.stats()
+        return out
 
 
 class StubSolveServer(FrameServer):

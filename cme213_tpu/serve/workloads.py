@@ -357,3 +357,86 @@ class StubAdapter:
 ADAPTERS = {a.op: a for a in (SpmvAdapter(), HeatAdapter(),
                               CipherAdapter(), SortAdapter(),
                               StubAdapter())}
+
+
+# ---------------------------------------------------------------- job kinds
+#
+# Long-job kinds are the batch-queue analog of the adapters above: where
+# an adapter maps a *request payload* onto one batched device program, a
+# job kind maps a *job record's params* onto a checkpointable solve the
+# executor (serve/jobs.py) drives one epoch at a time.  The contract:
+#   normalize(params) -> validated param dict (what the record stores)
+#   totals(params)    -> (total_iters, epoch_iters, total_epochs)
+#   make(params)      -> (state0, step_fn) for run_with_checkpoints
+#   tracker(params, job) -> ConvergenceTracker (stall policy + job tag)
+#   finalize(state)   -> np.ndarray result to persist
+#   reference(params) -> host-golden result for conformance checks
+
+class PageRankJob:
+    """hw1's PageRank power iteration as a durable long job — the solve
+    the reference queued through Torque ``qsub`` (``jobs/``), now
+    submitted over the serving wire and chunked into epochs through
+    ``apps/pagerank.py``'s checkpointed entry."""
+
+    op = "pagerank"
+
+    _DEFAULTS = {"nodes": 4096, "avg_edges": 8, "iters": 48, "epoch": 8,
+                 "seed": 0, "stall_epochs": 25, "tol": 0.0}
+
+    @classmethod
+    def normalize(cls, params: dict) -> dict:
+        p = dict(cls._DEFAULTS)
+        unknown = set(params) - set(p)
+        if unknown:
+            raise ValueError(f"unknown pagerank job params {sorted(unknown)}"
+                             f" (have: {sorted(p)})")
+        p.update(params)
+        for k in ("nodes", "avg_edges", "iters", "epoch", "seed",
+                  "stall_epochs"):
+            p[k] = int(p[k])
+        p["tol"] = float(p["tol"])
+        if p["nodes"] < 2 or p["avg_edges"] < 1:
+            raise ValueError("pagerank job needs nodes >= 2, avg_edges >= 1")
+        # the reference iterates in even pairs (pagerank.cu:61,127); an
+        # even epoch keeps every chunk on the fused even-iteration rung
+        if p["iters"] < 2 or p["iters"] % 2:
+            raise ValueError(f"iters must be even and >= 2, got {p['iters']}")
+        if p["epoch"] < 2 or p["epoch"] % 2:
+            raise ValueError(f"epoch must be even and >= 2, got {p['epoch']}")
+        return p
+
+    @staticmethod
+    def totals(p: dict) -> tuple[int, int, int]:
+        total, epoch = p["iters"], min(p["epoch"], p["iters"])
+        return total, epoch, -(-total // epoch)
+
+    @staticmethod
+    def make(p: dict):
+        from ..apps.pagerank import build_graph, pagerank_step
+
+        graph = build_graph(p["nodes"], p["avg_edges"], p["seed"])
+        return pagerank_step(graph)
+
+    @staticmethod
+    def tracker(p: dict, job: str):
+        from ..core.numerics import ConvergenceTracker
+
+        return ConvergenceTracker("job.pagerank",
+                                  stall_epochs=p["stall_epochs"], job=job)
+
+    @staticmethod
+    def finalize(state) -> np.ndarray:
+        return np.asarray(state)
+
+    @staticmethod
+    def reference(p: dict) -> np.ndarray:
+        from ..apps.pagerank import build_graph
+        from ..verify import golden
+
+        g = build_graph(p["nodes"], p["avg_edges"], p["seed"])
+        return golden.host_graph_iterate(g.indices, g.edges, g.rank0,
+                                         g.inv_deg, p["iters"])
+
+
+#: registered long-job kinds (serve/jobs.py executes these)
+JOB_KINDS = {PageRankJob.op: PageRankJob}

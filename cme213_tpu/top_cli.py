@@ -114,6 +114,21 @@ def render_top(state: dict, out=None) -> None:
                       f"iters/s={ips if ips is not None else '-'} "
                       f"{verdict}\n")
 
+    jobs = state.get("jobs") or {}
+    if jobs:
+        out.write("jobs:\n")
+        for jid, row in jobs.items():
+            epoch = row.get("epoch")
+            total = row.get("total_epochs")
+            res = row.get("residual")
+            out.write(f"  {jid:<18} {row.get('op') or '?':<10} "
+                      f"{row.get('state') or '?':<10} "
+                      f"epoch={epoch if epoch is not None else '-'}"
+                      f"/{total if total is not None else '-'} "
+                      f"residual={res if res is not None else '-'} "
+                      f"resumes={row.get('resumes', 0)} "
+                      f"preempt={row.get('preemptions', 0)}\n")
+
     spans = sorted(state["spans"].items(),
                    key=lambda kv: kv[1]["total_ms"], reverse=True)[:5]
     if spans:

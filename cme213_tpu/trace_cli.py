@@ -682,13 +682,17 @@ def summarize(events: list[dict], out=None) -> dict:
               f"(of {e.get('size')} elems)\n")
 
     # convergence (core/numerics.ConvergenceTracker feeders): per-op
-    # solver-progress rollup with the same stall policy `top` renders
+    # solver-progress rollup with the same stall policy `top` renders.
+    # Keyed by (op, job) — two jobs iterating the same op must not fold
+    # into one row, or a fresh job's high residual masks a stall.
     convergence = None
     progress = [e for e in events if e["event"] == "solver-progress"]
     if progress:
         convergence = {}
         for e in progress:
             op = str(e.get("op") or "solver")
+            if e.get("job"):
+                op = f"{op}[{e['job']}]"
             row = convergence.setdefault(
                 op, {"epochs": 0, "first_residual": e.get("residual"),
                      "last_residual": None, "last_step": None,
@@ -714,6 +718,59 @@ def summarize(events: list[dict], out=None) -> dict:
               f"{row['first_residual']} -> {row['last_residual']} "
               f"@step {row['last_step']}, {row['iters_per_s']} iters/s "
               f"{'STALLED' if row['stalled'] else ''}".rstrip() + "\n")
+
+    # durable long-job lane (serve/jobs.py): per-job lifecycle rollup.
+    # job-epoch is emitted only after the durable publish, so duplicate
+    # epoch numbers here mean a committed epoch was re-executed — the
+    # invariant the lane exists to uphold.
+    jobs_sec = None
+    job_evs = [e for e in events if str(e["event"]).startswith("job-")]
+    if job_evs:
+        jobs_sec = {}
+        for e in job_evs:
+            jid = str(e.get("job") or "?")
+            row = jobs_sec.setdefault(
+                jid, {"op": None, "state": None, "epoch": None,
+                      "total_epochs": None, "residual": None, "epochs": 0,
+                      "dup_epochs": 0, "resumes": 0, "preemptions": 0,
+                      "reassignments": 0, "_seen": set()})
+            if e.get("op"):
+                row["op"] = e.get("op")
+            ev = e["event"]
+            if ev == "job-submitted":
+                row["state"] = "PENDING"
+                row["total_epochs"] = e.get("total_epochs")
+            elif ev == "job-epoch":
+                row["state"] = "RUNNING"
+                row["epoch"] = e.get("epoch")
+                row["residual"] = e.get("residual")
+                row["epochs"] += 1
+                if e.get("epoch") in row["_seen"]:
+                    row["dup_epochs"] += 1
+                row["_seen"].add(e.get("epoch"))
+            elif ev == "job-preempted":
+                row["state"] = "PREEMPTED"
+                row["preemptions"] += 1
+            elif ev == "job-resumed":
+                row["state"] = "RUNNING"
+                row["resumes"] += 1
+            elif ev == "job-reassigned":
+                row["reassignments"] += 1
+            elif ev == "job-done":
+                row["state"] = e.get("state")
+        for row in jobs_sec.values():
+            row.pop("_seen")
+        w(f"jobs: {len(jobs_sec)} job(s), {len(job_evs)} event(s)\n")
+        for jid, row in sorted(jobs_sec.items()):
+            w(f"  {jid} [{row['op']}]: {row['state']} "
+              f"epoch {row['epoch']}/{row['total_epochs']}, "
+              f"residual {row['residual']}, "
+              f"{row['resumes']} resume(s), "
+              f"{row['preemptions']} preemption(s)"
+              + (f", {row['reassignments']} reassignment(s)"
+                 if row["reassignments"] else "")
+              + (f" [REEXECUTED x{row['dup_epochs']}]"
+                 if row["dup_epochs"] else "") + "\n")
 
     # autotuning (core/tune.py): search activity + the tuned-vs-default
     # split at dispatch — the "is the cache actually consulted" signal
@@ -795,6 +852,7 @@ def summarize(events: list[dict], out=None) -> dict:
             "slo": slo,
             "numerics": numeric,
             "convergence": convergence,
+            "jobs": jobs_sec,
             "tuning": tuning,
             "counts": dict(counts)}
 

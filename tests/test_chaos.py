@@ -31,7 +31,7 @@ from cme213_tpu.core.chaos import (
     validate_cocktail,
 )
 from cme213_tpu.core.faults import FaultPlan, _Clause
-from cme213_tpu.serve.workloads import ADAPTERS
+from cme213_tpu.serve.workloads import ADAPTERS, JOB_KINDS
 
 FIXTURES = sorted(glob.glob(os.path.join(
     os.path.dirname(__file__), "chaos_fixtures", "*.json")))
@@ -98,9 +98,15 @@ def test_reset_counters_rearms_clauses():
 # ------------------------------------------------- compatibility matrix
 
 def test_topology_matches_live_adapters():
-    assert set(TOPOLOGY) == set(ADAPTERS)
-    for op, topo in TOPOLOGY.items():
-        assert topo["rungs"] == ADAPTERS[op].rungs(False), op
+    # job-lane entries describe long-job kinds, not serving adapters:
+    # they must match JOB_KINDS instead of ADAPTERS
+    serving = {op for op, t in TOPOLOGY.items() if not t.get("job")}
+    job_ops = {op for op, t in TOPOLOGY.items() if t.get("job")}
+    assert serving == set(ADAPTERS)
+    assert job_ops == set(JOB_KINDS)
+    assert job_ops == set(chaos.JOB_PARAMS)
+    for op in serving:
+        assert TOPOLOGY[op]["rungs"] == ADAPTERS[op].rungs(False), op
 
 
 def test_matrix_covers_full_grammar():
@@ -270,6 +276,62 @@ def test_unknown_handicap_and_backend_rejected():
     with pytest.raises(ValueError, match="backend"):
         run_campaign("fail:x:1", backend="warp", mix="cipher",
                      requests=2, seed=0)
+
+
+def test_ckpt_only_drawable_in_job_campaigns():
+    # without a job op the pool has no ckpt targets; with one it does,
+    # and the drawn clauses target the two durable-writer crash windows
+    ops = ["cipher", "sort"]
+    assert "ckpt" not in chaos.clause_targets("inproc", ops, 2)
+    pool = chaos.clause_targets("inproc", ops + ["pagerank"], 2)
+    assert sorted(t["op"] for t in pool["ckpt"]) == ["commit", "truncate"]
+    # fleet backend never draws ckpt (the guards fire in the runner)
+    assert "ckpt" not in chaos.clause_targets("fleet",
+                                              ops + ["pagerank"], 2)
+
+
+def test_ckpt_campaign_without_job_refused():
+    with pytest.raises(ValueError, match="job campaign"):
+        run_campaign("ckpt:commit:1", backend="inproc", mix="cipher",
+                     requests=2, seed=0)
+    with pytest.raises(ValueError, match="inproc"):
+        run_campaign("ckpt:commit:1", backend="fleet", mix="cipher",
+                     requests=2, seed=0, job="pagerank")
+
+
+def test_job_campaign_survives_both_ckpt_windows():
+    # the tentpole invariant, stated as a campaign: a torn epoch
+    # checkpoint AND a lost record publish in one run, and the job
+    # still reaches DONE with a bitwise-reference result and no
+    # committed epoch re-executed
+    res = run_campaign("ckpt:truncate:1,ckpt:commit:1", backend="inproc",
+                       mix="cipher", requests=8, seed=11, job="pagerank")
+    assert res.ok, [v.as_dict() for v in res.violations]
+    assert res.job == "pagerank"
+    done = [e for e in trace.events("job-done")]
+    assert done and done[-1]["state"] == "DONE"
+
+
+def test_job_campaign_handicap_drill_violates_and_replays(tmp_path):
+    # the deliberate breakage: commit retries handicapped off, so one
+    # injected publish crash fails the job -> "job" violation ->
+    # shrinks to the single commit clause -> banked fixture reproduces
+    cocktail = "ckpt:commit:1,slow:serve.cipher:20.0:1:1"
+    kw = dict(backend="inproc", mix="cipher", requests=6, seed=12,
+              job="pagerank", handicaps=("ckpt-retry",))
+    res = run_campaign(cocktail, **kw)
+    assert {v.invariant for v in res.violations} == {"job"}
+
+    def failing(p):
+        return bool(run_campaign(p, **kw).violations)
+
+    minimal = shrink(FaultPlan.parse(cocktail), failing)
+    assert str(minimal) == "ckpt:commit:1"
+    path = bank_fixture(res, minimal, directory=str(tmp_path),
+                        handicaps=("ckpt-retry",))
+    replayed, expected, observed = replay_fixture(path)
+    assert expected == observed == ["job"]
+    assert replayed.job == "pagerank"
 
 
 def test_drill_violates_shrinks_banks_and_replays(tmp_path):

@@ -1,0 +1,503 @@
+"""Durable long-job lane (``serve/jobs.py``): store durability, the
+write-ahead epoch loop, preemption/resume, and the transport controls.
+
+The contract under test is the one Torque gave the reference's
+``qsub`` scripts: a submitted solve survives the death of whatever was
+running it.  Here that means (a) the record store survives torn writes
+(CRC + ``.prev`` fallback + quarantine), (b) a committed epoch is never
+re-executed — after any crash/injected-fault recovery the ``job-epoch``
+numbers stay unique and the final ranking is **bitwise-equal** to an
+uninterrupted run, and (c) interactive traffic strictly preempts job
+epochs at epoch boundaries.  The ``slow``-marked arcs run the same
+story against a real worker fleet: SIGKILL mid-job and a whole-fleet
+down/up with the jobs directory as the only survivor.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cme213_tpu.core import faults, metrics, trace
+from cme213_tpu.serve import Server
+from cme213_tpu.serve.loadgen import build_mix
+from cme213_tpu.serve import jobs as jobs_mod
+from cme213_tpu.serve import wire
+from cme213_tpu.serve.jobs import (
+    DONE,
+    FAILED,
+    PENDING,
+    PREEMPTED,
+    RUNNING,
+    JobError,
+    JobExecutor,
+    JobStore,
+    submit_job,
+)
+from cme213_tpu.serve.workloads import JOB_KINDS, PageRankJob
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    trace.clear_events()
+    metrics.reset()
+    faults.reset()
+    yield
+    faults.reset()
+    metrics.reset()
+
+
+#: small-but-multi-epoch PageRank: 3 epochs of 4 iterations (the
+#: kind requires even epochs: the fused rung iterates in pairs)
+PARAMS = {"nodes": 96, "avg_edges": 4, "iters": 12, "epoch": 4, "seed": 7}
+
+
+def _bits(arr) -> bytes:
+    return np.ascontiguousarray(np.asarray(arr)).tobytes()
+
+
+def _run_to_terminal(ex: JobExecutor, budget: int = 200) -> None:
+    for _ in range(budget):
+        if not ex.tick():
+            if all(r["state"] in jobs_mod.TERMINAL
+                   for r in ex.store.list_jobs()):
+                return
+        time.sleep(0)
+    raise AssertionError("job did not reach a terminal state in budget")
+
+
+def _clean_result(tmp_path, params=None) -> np.ndarray:
+    """Uninterrupted run in a scratch store — the bitwise baseline."""
+    store = JobStore(str(tmp_path / "baseline"))
+    submit_job(store, "baseline", "pagerank", dict(params or PARAMS))
+    _run_to_terminal(JobExecutor(store, rank="base"))
+    rec = store.load("baseline")
+    assert rec["state"] == DONE
+    return store.load_result("baseline")
+
+
+# ------------------------------------------------------------- the store
+
+
+def test_submit_is_idempotent(tmp_path):
+    store = JobStore(str(tmp_path))
+    rec1, created1 = submit_job(store, "j1", "pagerank", dict(PARAMS))
+    rec2, created2 = submit_job(store, "j1", "pagerank", dict(PARAMS))
+    assert created1 and not created2
+    assert rec1 == rec2 and rec2["state"] == PENDING
+    assert len(trace.events("job-submitted")) == 1
+    assert rec1["total_epochs"] == 3 and rec1["epoch_iters"] == 4
+
+
+def test_bad_ids_and_unknown_ops_are_refused(tmp_path):
+    store = JobStore(str(tmp_path))
+    with pytest.raises(JobError):
+        submit_job(store, "../escape", "pagerank", {})
+    with pytest.raises(JobError):
+        submit_job(store, "j1", "not-a-job", {})
+    with pytest.raises(ValueError):
+        submit_job(store, "j1", "pagerank", {"bogus_knob": 3})
+
+
+def test_illegal_transition_raises(tmp_path):
+    store = JobStore(str(tmp_path))
+    rec, _ = submit_job(store, "j1", "pagerank", dict(PARAMS))
+    with pytest.raises(JobError):
+        store.publish(rec, state=DONE)       # PENDING -> DONE is illegal
+    rec = store.load("j1")
+    assert rec["state"] == PENDING
+
+
+def test_torn_record_falls_back_to_prev_and_quarantines(tmp_path):
+    store = JobStore(str(tmp_path))
+    rec, _ = submit_job(store, "j1", "pagerank", dict(PARAMS))
+    store.publish(rec, state=RUNNING)        # retains PENDING at .prev
+    path = store.record_path("j1")
+    with open(path, "w") as f:
+        f.write('{"torn": tru')              # torn mid-write
+    loaded = store.load("j1")
+    assert loaded is not None and loaded["state"] == PENDING
+    assert (tmp_path / "job-j1.json.corrupt").exists()
+    assert metrics.counter("jobs.record_quarantines").value == 1
+    # a CRC mismatch (bit rot, not torn JSON) is quarantined the same way
+    doc = json.loads((tmp_path / "job-j1.json.prev").read_text())
+    doc["state"] = RUNNING                   # flipped without re-CRC
+    (tmp_path / "job-j1.json.prev").write_text(json.dumps(doc))
+    assert store.load("j1") is None
+    assert (tmp_path / "job-j1.json.prev.corrupt").exists()
+
+
+def test_reassign_from_moves_only_live_jobs(tmp_path):
+    store = JobStore(str(tmp_path))
+    for jid in ("a", "b", "c"):
+        submit_job(store, jid, "pagerank", dict(PARAMS))
+    assert store.claim("a", "0") and store.claim("b", "0")
+    assert store.claim("c", "1")
+    rec = store.load("b")
+    store.publish(rec, state=FAILED, reason="x")   # terminal: stays put
+    moved = store.reassign_from("0", "2")
+    assert moved == ["a"]
+    assert store.owner("a") == "2" and store.owner("b") == "0"
+    assert store.owner("c") == "1"
+
+
+# ---------------------------------------------------------- the executor
+
+
+def test_executor_runs_pagerank_to_done(tmp_path):
+    store = JobStore(str(tmp_path))
+    submit_job(store, "j1", "pagerank", dict(PARAMS))
+    ex = JobExecutor(store, rank="0")
+    _run_to_terminal(ex)
+    rec = store.load("j1")
+    assert rec["state"] == DONE
+    assert rec["epoch"] == rec["total_epochs"] == 3
+    assert rec["iters"] == rec["total_iters"] == 12
+    value = store.load_result("j1")
+    ref = PageRankJob.reference(rec["params"])
+    np.testing.assert_allclose(value, ref, rtol=1e-5, atol=1e-7)
+    # committed epochs are unique — nothing ran twice
+    epochs = [e["epoch"] for e in trace.events("job-epoch")]
+    assert epochs == [1, 2, 3]
+    done = trace.events("job-done")
+    assert done and done[-1]["state"] == DONE
+
+
+def test_duplicate_submit_after_done_returns_original_result(tmp_path):
+    store = JobStore(str(tmp_path))
+    submit_job(store, "j1", "pagerank", dict(PARAMS))
+    _run_to_terminal(JobExecutor(store, rank="0"))
+    first = store.load_result("j1")
+    rec, created = submit_job(store, "j1", "pagerank", dict(PARAMS))
+    assert not created and rec["state"] == DONE
+    assert _bits(store.load_result("j1")) == _bits(first)
+    # the executor has nothing to do for it either
+    assert JobExecutor(store, rank="0").tick() is False
+
+
+def test_cancel_finishes_the_job_failed(tmp_path):
+    store = JobStore(str(tmp_path))
+    submit_job(store, "j1", "pagerank", dict(PARAMS))
+    store.request_cancel("j1")
+    ex = JobExecutor(store, rank="0")
+    assert ex.tick() is True
+    rec = store.load("j1")
+    assert rec["state"] == FAILED and rec["reason"] == "cancelled"
+
+
+def test_injected_commit_abort_replays_intent_bitwise(tmp_path):
+    """The ``ckpt:commit`` window: the epoch checkpoint is durable but
+    the record publish dies.  The write-ahead intent re-targets the SAME
+    epoch next tick; iterations already committed are never re-run and
+    the final ranking is bitwise-equal to an uninterrupted solve."""
+    baseline = _clean_result(tmp_path)
+    store = JobStore(str(tmp_path / "jobs"))
+    submit_job(store, "j1", "pagerank", dict(PARAMS))
+    ex = JobExecutor(store, rank="0")
+    # publish #1 is the PENDING->RUNNING activation; #2 is epoch 1's
+    with faults.injected("ckpt:commit:2"):
+        _run_to_terminal(ex)
+    assert metrics.counter("jobs.commit_failures").value == 1
+    assert metrics.counter("jobs.intent_replays").value == 1
+    rec = store.load("j1")
+    assert rec["state"] == DONE
+    epochs = [e["epoch"] for e in trace.events("job-epoch")
+              if e["job"] == "j1"]
+    assert epochs == [1, 2, 3]               # no committed epoch re-ran
+    assert _bits(store.load_result("j1")) == _bits(baseline)
+
+
+def test_commit_retry_budget_fails_the_job(tmp_path):
+    store = JobStore(str(tmp_path))
+    submit_job(store, "j1", "pagerank", dict(PARAMS))
+    ex = JobExecutor(store, rank="0", commit_retries=0)
+    with faults.injected("ckpt:commit:2"):
+        _run_to_terminal(ex)
+    rec = store.load("j1")
+    assert rec["state"] == FAILED and rec["reason"] == "commit-failed"
+
+
+def test_torn_epoch_checkpoint_recovers_from_prev(tmp_path):
+    """``ckpt:truncate`` tears the epoch ``.npz`` mid-write: the loader
+    quarantines it, the retained ``.prev`` serves, and the job still
+    finishes bitwise-equal."""
+    baseline = _clean_result(tmp_path)
+    store = JobStore(str(tmp_path / "jobs"))
+    submit_job(store, "j1", "pagerank", dict(PARAMS))
+    ex = JobExecutor(store, rank="0")
+    with faults.injected("ckpt:truncate:2"):
+        _run_to_terminal(ex)
+    rec = store.load("j1")
+    assert rec["state"] == DONE
+    assert _bits(store.load_result("j1")) == _bits(baseline)
+
+
+def test_crash_resume_is_bitwise_equal(tmp_path):
+    """A new process (new executor, same rank) finds a RUNNING record it
+    never started: resumes with source ``crash`` from the last durable
+    epoch, continues the epoch numbering, and lands bitwise-equal."""
+    baseline = _clean_result(tmp_path)
+    store = JobStore(str(tmp_path / "jobs"))
+    submit_job(store, "j1", "pagerank", dict(PARAMS))
+    ex1 = JobExecutor(store, rank="0")
+    assert ex1.tick() and ex1.tick()         # activate + epochs 1..2
+    while store.load("j1")["epoch"] < 2:
+        ex1.tick()
+    del ex1                                  # SIGKILL stand-in: no exit path
+    # another rank must NOT steal the claim while the owner may be alive
+    thief = JobExecutor(store, rank="1")
+    assert thief.tick() is False
+    ex2 = JobExecutor(JobStore(str(tmp_path / "jobs")), rank="0")
+    _run_to_terminal(ex2)
+    resumed = trace.events("job-resumed")
+    assert [e["source"] for e in resumed] == ["crash"]
+    rec = store.load("j1")
+    assert rec["state"] == DONE and rec["resumes"] == 1
+    epochs = [e["epoch"] for e in trace.events("job-epoch")
+              if e["job"] == "j1"]
+    assert sorted(set(epochs)) == epochs == [1, 2, 3]
+    assert _bits(store.load_result("j1")) == _bits(baseline)
+
+
+def test_interactive_queue_preempts_then_resumes(tmp_path):
+    """Queued interactive work preempts the job at the epoch boundary
+    (never mid-epoch); the drained queue lets it resume where it left
+    off with source ``preempted``."""
+    server = Server(capacity=8, max_batch=4)
+    store = JobStore(str(tmp_path))
+    submit_job(store, "j1", "pagerank", dict(PARAMS))
+    ex = JobExecutor(store, server=server, rank="0")
+    assert ex.tick() is True                 # epoch 1 in an idle gap
+    spec = build_mix("cipher", 1, seed=5)[0]
+    assert server.submit(spec.op, spec.payload) is not None
+    assert ex.tick() is False                # preempted, no epoch ran
+    rec = store.load("j1")
+    assert rec["state"] == PREEMPTED and rec["preemptions"] == 1
+    assert rec["epoch"] == 1                 # boundary, not mid-epoch
+    assert trace.events("job-preempted")[-1]["reason"] == "queue-depth"
+    server.step()                            # interactive batch drains
+    _run_to_terminal(ex)
+    assert [e["source"] for e in trace.events("job-resumed")] \
+        == ["preempted"]
+    assert store.load("j1")["state"] == DONE
+
+
+def test_stalled_job_gets_the_stalled_verdict(tmp_path):
+    store = JobStore(str(tmp_path))
+    # tiny graph converges almost immediately; a 1-epoch stall budget
+    # trips STALLED long before the iteration budget runs out
+    submit_job(store, "j1", "pagerank",
+               {"nodes": 16, "avg_edges": 2, "iters": 400, "epoch": 2,
+                "stall_epochs": 1})
+    _run_to_terminal(JobExecutor(store, rank="0"))
+    rec = store.load("j1")
+    assert rec["state"] == jobs_mod.STALLED
+    assert rec["reason"] == "convergence-stall"
+    assert rec["iters"] < rec["total_iters"]
+
+
+# ------------------------------------------------- controls + transport
+
+
+def test_handle_control_verbs(tmp_path):
+    store = JobStore(str(tmp_path))
+    out = jobs_mod.handle_control(
+        store, {"control": "job-submit", "job": "j1", "op": "pagerank",
+                "params": dict(PARAMS)})
+    assert out["ok"] and out["created"] and out["job"]["state"] == PENDING
+    again = jobs_mod.handle_control(
+        store, {"control": "job-submit", "job": "j1", "op": "pagerank"})
+    assert again["ok"] and not again["created"]
+    assert jobs_mod.handle_control(
+        store, {"control": "job-status", "job": "nope"})["ok"] is False
+    assert jobs_mod.handle_control(
+        store, {"control": "job-result", "job": "j1"})["ok"] is False
+    _run_to_terminal(JobExecutor(store, rank="0"))
+    res = jobs_mod.handle_control(store, {"control": "job-result",
+                                          "job": "j1"})
+    assert res["ok"] and res["job"]["state"] == DONE
+    value = wire.nd_b64_decode(res["value"])
+    assert _bits(value) == _bits(store.load_result("j1"))
+    listing = jobs_mod.handle_control(store, {"control": "job-list"})
+    assert [r["job"] for r in listing["jobs"]] == ["j1"]
+
+
+def test_job_lane_over_transport_under_interactive_load(tmp_path):
+    """The full wire arc on one replica: submit over a control frame,
+    interactive solves keep landing (and strictly win the server),
+    status polls show progress, and the result round-trips bitwise."""
+    from cme213_tpu.serve import OK
+    from cme213_tpu.serve.transport import TransportClient, TransportServer
+
+    baseline = _clean_result(tmp_path)
+    server = Server(capacity=32, max_batch=4)
+    store = JobStore(str(tmp_path / "jobs"))
+    ts = TransportServer(server, drive="thread")
+    ts.attach_jobs(JobExecutor(store, server=server, rank="0"))
+    ts.start()
+    try:
+        with TransportClient(ts.addr) as c:
+            out = c.control("job-submit", job="j1", op="pagerank",
+                            params=dict(PARAMS))
+            assert out["ok"] and out["created"]
+            for spec in build_mix("cipher", 6, seed=5):
+                res = c.solve(spec.op, spec.payload)   # rides along
+                assert res.status == OK
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                st = c.control("job-status", job="j1")
+                assert st["ok"]
+                if st["job"]["state"] in jobs_mod.TERMINAL:
+                    break
+                time.sleep(0.05)
+            assert st["job"]["state"] == DONE
+            assert st["job"]["owner"] == "0"
+            res = c.control("job-result", job="j1")
+            assert res["ok"]
+            assert _bits(wire.nd_b64_decode(res["value"])) \
+                == _bits(baseline)
+    finally:
+        ts.close()
+
+
+def test_orphan_adoption_after_restart(tmp_path):
+    """Whole-fleet restart in miniature: the previous owner's rank is
+    gone, the store's claim is reassigned, and the adopting executor
+    resumes from the durable epoch — the ``job-reassigned`` +
+    ``job-resumed(restart/crash)`` arc ``serve/fleet.py`` drives."""
+    baseline = _clean_result(tmp_path)
+    store = JobStore(str(tmp_path / "jobs"))
+    submit_job(store, "j1", "pagerank", dict(PARAMS))
+    ex0 = JobExecutor(store, rank="7")       # a rank that will not return
+    while store.load("j1")["epoch"] < 2:
+        ex0.tick()
+    del ex0
+    moved = store.reassign_from("7", "0")
+    assert moved == ["j1"]
+    _run_to_terminal(JobExecutor(store, rank="0"))
+    rec = store.load("j1")
+    assert rec["state"] == DONE and rec["resumes"] == 1
+    assert trace.events("job-resumed")[-1]["source"] == "crash"
+    assert _bits(store.load_result("j1")) == _bits(baseline)
+
+
+# ------------------------------------------------- e2e fleet kill arcs
+
+
+def _fleet_submit_and_wait(addr, job, params, deadline_s=120.0,
+                           min_epoch_before=None, poke=None):
+    from cme213_tpu.serve.transport import TransportClient
+
+    with TransportClient(addr) as c:
+        out = c.control("job-submit", job=job, op="pagerank", params=params)
+        assert out["ok"]
+    deadline = time.monotonic() + deadline_s
+    poked = False
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            with TransportClient(addr) as c:
+                st = c.control("job-status", job=job)
+        except (ConnectionError, OSError):
+            time.sleep(0.2)                  # front end mid-restart
+            continue
+        assert st["ok"], st
+        last = st["job"]
+        if (poke is not None and not poked
+                and (last["epoch"] or 0) >= (min_epoch_before or 1)):
+            poke()
+            poked = True
+        if last["state"] in jobs_mod.TERMINAL:
+            return last
+        time.sleep(0.1)
+    raise AssertionError(f"job never finished: {last}")
+
+
+def _fleet_result(addr, job):
+    from cme213_tpu.serve.transport import TransportClient
+
+    with TransportClient(addr) as c:
+        res = c.control("job-result", job=job)
+    assert res["ok"], res
+    return wire.nd_b64_decode(res["value"])
+
+
+@pytest.mark.slow
+def test_fleet_job_survives_replica_sigkill(tmp_path, monkeypatch):
+    """One replica, SIGKILLed mid-job by an injected ``replica-kill``
+    clause: the relaunched incarnation resumes its own claim from the
+    durable epoch and the final ranking is bitwise-equal to an
+    uninterrupted in-process run."""
+    from cme213_tpu.serve import OK
+    from cme213_tpu.serve.fleet import Fleet
+    from cme213_tpu.serve.transport import TransportClient
+
+    # long enough (40 epochs) that the kill lands mid-job, not after it
+    params = {"nodes": 3000, "avg_edges": 6, "iters": 160, "epoch": 4,
+              "seed": 11, "stall_epochs": 1000}
+    baseline = _clean_result(tmp_path, params)
+    monkeypatch.setenv("CME213_FAULTS", "replica-kill:0:1")
+    fleet = Fleet(replicas=1, mix="cipher", warm_requests=2, max_batch=4,
+                  jobs_dir=str(tmp_path / "jobs")).start()
+    try:
+        def poke():
+            # interactive batches arm the kill guard; every accepted
+            # request must still be served (zero interactive loss)
+            with TransportClient(fleet.addr) as c:
+                for spec in build_mix("cipher", 4, seed=5):
+                    res = c.solve(spec.op, spec.payload)
+                    assert res.status == OK
+        rec = _fleet_submit_and_wait(fleet.addr, "kill-arc", params,
+                                     min_epoch_before=1, poke=poke)
+        assert rec["state"] == DONE
+        assert rec["resumes"] >= 1           # the relaunch resumed it
+        value = _fleet_result(fleet.addr, "kill-arc")
+        stats = fleet.front.stats()          # the wire-facing view
+    finally:
+        fleet.close()
+    assert _bits(value) == _bits(baseline)
+    assert stats["replicas"]["r0"]["incarnation"] >= 1
+    assert stats["jobs"].get(DONE) == 1
+
+
+@pytest.mark.slow
+def test_fleet_down_up_resumes_job(tmp_path):
+    """Whole-fleet restart: every process dies, the jobs directory is
+    the only survivor, and a brand-new fleet finishes the job
+    bitwise-equal without re-running committed epochs."""
+    from cme213_tpu.serve.fleet import Fleet
+    from cme213_tpu.serve.transport import TransportClient
+
+    params = {"nodes": 3000, "avg_edges": 6, "iters": 160, "epoch": 4,
+              "seed": 12, "stall_epochs": 1000}
+    baseline = _clean_result(tmp_path, params)
+    jobs_dir = str(tmp_path / "jobs")
+    fleet = Fleet(replicas=1, mix="cipher", warm_requests=2,
+                  jobs_dir=jobs_dir).start()
+    try:
+        with TransportClient(fleet.addr) as c:
+            out = c.control("job-submit", job="downup", op="pagerank",
+                            params=params)
+            assert out["ok"] and out["created"]
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            with TransportClient(fleet.addr) as c:
+                st = c.control("job-status", job="downup")
+            if (st["job"]["epoch"] or 0) >= 2:
+                break
+            time.sleep(0.1)
+        assert (st["job"]["epoch"] or 0) >= 2, st
+    finally:
+        fleet.close()                        # the whole fleet goes down
+    fleet2 = Fleet(replicas=1, mix="cipher", warm_requests=2,
+                   jobs_dir=jobs_dir).start()
+    try:
+        rec = _fleet_submit_and_wait(fleet2.addr, "downup", params)
+        assert rec["state"] == DONE
+        assert rec["resumes"] >= 1
+        value = _fleet_result(fleet2.addr, "downup")
+    finally:
+        fleet2.close()
+    assert _bits(value) == _bits(baseline)
