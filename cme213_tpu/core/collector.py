@@ -142,6 +142,9 @@ class Collector:
         #: durable long-job rows from job-* lifecycle events (serve/jobs.py)
         self.jobs: dict[str, dict] = {}
         self.recent: collections.deque = collections.deque(maxlen=64)
+        #: slowest request hops seen (serve.hop.client / serve.hop.route
+        #: span-ends), descending ms — the "which requests hurt" ribbon
+        self.slowest: list[dict] = []
         self.last_commit: dict | None = None
         self.last_rc = None
         self.events = 0
@@ -292,6 +295,8 @@ class Collector:
                 agg["count"] += 1
                 agg["total_ms"] = round(agg["total_ms"] + ms, 3)
                 agg["max_ms"] = max(agg["max_ms"], round(ms, 3))
+                if name in ("serve.hop.client", "serve.hop.route"):
+                    self._note_slowest(rec, ms)
         elif event == "metrics-snapshot":
             if isinstance(rec.get("metrics"), dict):
                 row["metrics"] = rec["metrics"]
@@ -299,6 +304,23 @@ class Collector:
         if event not in ("span-begin", "span-end", "heartbeat",
                          "solver-progress"):
             self.recent.append({"t": t, "rank": key, "event": event})
+
+    #: slowest-traces ribbon depth
+    _SLOWEST_N = 8
+
+    def _note_slowest(self, rec: dict, ms: float) -> None:
+        """Track the top-N slowest request hops.  The entry carries
+        everything `trace waterfall` needs to pull the full tree: the
+        rid tag (its argument) and the trace id (the cross-file join
+        key).  Client and route hops both feed the ribbon — whichever
+        tier's sink the collector can see still surfaces the pain."""
+        self.slowest.append({
+            "span": rec.get("span"), "ms": round(ms, 3),
+            "rid": rec.get("rid"), "trace": rec.get("trace"),
+            "rank": _rank_key(rec), "status": rec.get("status"),
+            "requeues": rec.get("requeues"), "t": rec.get("t")})
+        self.slowest.sort(key=lambda e: -e["ms"])
+        del self.slowest[self._SLOWEST_N:]
 
     #: solver-progress stall policy (matches ConvergenceTracker defaults)
     _STALL_EPOCHS = 5
@@ -390,6 +412,7 @@ class Collector:
             "solvers": {k: dict(v) for k, v in sorted(self.solvers.items())},
             "jobs": {k: dict(v) for k, v in sorted(self.jobs.items())},
             "spans": {k: dict(v) for k, v in sorted(self.spans.items())},
+            "slowest_traces": [dict(e) for e in self.slowest],
             "recent": list(self.recent),
             "last_rc": self.last_rc,
             "last_commit": self.last_commit,
@@ -454,6 +477,11 @@ def render_state(state: dict, out) -> None:
         for v in state["verdicts"]:
             out.write(f"  verdict: rank {v['rank']} {v['reason']} "
                       f"(incarnation {v['incarnation']})\n")
+    for e in state.get("slowest_traces", [])[:4]:
+        out.write(f"  slow: {e['ms']}ms {e['span']} rid={e['rid']} "
+                  f"trace={e['trace']} ({e['rank']}"
+                  + (f", {e['requeues']} requeue(s)" if e.get("requeues")
+                     else "") + ")\n")
     if state["malformed"]:
         out.write(f"  malformed lines skipped: {state['malformed']}\n")
 

@@ -35,6 +35,7 @@ import math
 import os
 import re
 import threading
+from bisect import bisect_left
 from collections import deque
 
 #: optional path for a Prometheus text-format dump, written atomically at
@@ -43,8 +44,19 @@ from collections import deque
 #: trace JSONL
 METRICS_FILE_ENV = "CME213_METRICS_FILE"
 
+#: truthy -> render histograms in the pre-bucket quantile-summary form
+#: (``{quantile="..."}`` lines) instead of native ``_bucket`` families
+SUMMARY_COMPAT_ENV = "CME213_METRICS_SUMMARY_COMPAT"
+
 #: observations retained per histogram for percentile estimates
 KEEP = 4096
+
+#: log-spaced cumulative-bucket upper bounds (powers of two from 0.25 to
+#: 32768 — ms-scale latencies land mid-range), plus an implicit +Inf;
+#: exact per-bucket counts are kept incrementally so the Prometheus
+#: rendering needs no window replay and merges across ranks exactly
+BUCKET_BOUNDS: tuple[float, ...] = tuple(
+    float(2.0 ** e) for e in range(-2, 16))
 
 _LOCK = threading.Lock()
 _COUNTERS: dict[str, "Counter"] = {}
@@ -86,7 +98,8 @@ class Histogram:
     """Named distribution: exact count/sum/min/max plus percentiles over
     the last ``KEEP`` observations (a ring — bounded by construction)."""
 
-    __slots__ = ("name", "count", "total", "min", "max", "_recent")
+    __slots__ = ("name", "count", "total", "min", "max", "_recent",
+                 "buckets")
 
     def __init__(self, name: str) -> None:
         self.name = name
@@ -95,6 +108,9 @@ class Histogram:
         self.min = None
         self.max = None
         self._recent: deque = deque(maxlen=KEEP)
+        # per-bucket (non-cumulative) counts; index len(BUCKET_BOUNDS)
+        # is the +Inf overflow bucket
+        self.buckets: list[int] = [0] * (len(BUCKET_BOUNDS) + 1)
 
     def observe(self, value: float) -> "Histogram":
         value = float(value)
@@ -104,6 +120,7 @@ class Histogram:
             self.min = value if self.min is None else min(self.min, value)
             self.max = value if self.max is None else max(self.max, value)
             self._recent.append(value)
+            self.buckets[bisect_left(BUCKET_BOUNDS, value)] += 1
         return self
 
     def percentile(self, q: float) -> float | None:
@@ -136,6 +153,7 @@ class Histogram:
             "p50": pct(0.50),
             "p90": pct(0.90),
             "p99": pct(0.99),
+            "buckets": list(self.buckets),
         }
 
 
@@ -287,6 +305,11 @@ def merge_snapshots(snaps: dict[str, dict]) -> dict:
                               ("p50", max), ("p90", max), ("p99", max)):
                 a, b = m.get(key), h.get(key)
                 m[key] = b if a is None else (a if b is None else fold(a, b))
+            ba, bb = m.get("buckets"), h.get("buckets")
+            if ba and bb and len(ba) == len(bb):
+                m["buckets"] = [x + y for x, y in zip(ba, bb)]
+            elif bb and not ba:
+                m["buckets"] = list(bb)
     for h in hists.values():
         h["mean"] = (round((h.get("sum") or 0) / h["count"], 6)
                      if h.get("count") else None)
@@ -306,6 +329,11 @@ def _merge_labels(labels: str, extra: str | None) -> str:
     return labels[:-1] + "," + extra + "}"
 
 
+def _summary_compat() -> bool:
+    raw = os.environ.get(SUMMARY_COMPAT_ENV, "")
+    return raw.strip().lower() not in ("", "0", "false", "no", "off")
+
+
 def _help_text(family: str) -> str:
     prefix = "cme213_"
     stem = family[len(prefix):] if family.startswith(prefix) else family
@@ -323,10 +351,14 @@ def render_prometheus(snap: dict | None = None, *,
     ``served.<op>.<rung>``, ``faults.<kind>``) fold their variable
     segments into labels.  Numeric gauges render as gauges (non-numeric
     gauge values are skipped — Prometheus has no string samples).
-    Histograms render as summaries: ``{quantile="0.5|0.9|0.99"}`` lines
-    from the retained window plus exact ``_sum``/``_count``.  Every
-    family leads with a ``# HELP`` line (suppress with
-    ``help_lines=False``).
+    Histograms render as native cumulative-bucket families:
+    ``_bucket{le="<bound>"}`` lines over :data:`BUCKET_BOUNDS` plus
+    ``le="+Inf"`` and exact ``_sum``/``_count``.  Setting
+    ``CME213_METRICS_SUMMARY_COMPAT`` (truthy) restores the historical
+    quantile-summary rendering (``{quantile="0.5|0.9|0.99"}`` lines
+    from the retained window); snapshots predating the bucket counts
+    fall back to that form per metric.  Every family leads with a
+    ``# HELP`` line (suppress with ``help_lines=False``).
 
     With ``fleet`` — a ``{rank-label: snapshot}`` mapping — the
     federated form renders instead: the :func:`merge_snapshots` rollup
@@ -355,15 +387,31 @@ def render_prometheus(snap: dict | None = None, *,
             pname = f"cme213_{_sanitize_name(name)}"
             add(pname, "gauge",
                 f"{pname}{_merge_labels('', extra)} {value}")
+        compat = _summary_compat()
         for name, h in (s.get("histograms") or {}).items():
             pname = f"cme213_{_sanitize_name(name)}"
-            for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
-                if h.get(key) is not None:
-                    qlabels = _merge_labels(f'{{quantile="{q}"}}', extra)
-                    add(pname, "summary", f"{pname}{qlabels} {h[key]}")
-            add(pname, "summary",
+            raw = h.get("buckets")
+            if not compat and raw and len(raw) == len(BUCKET_BOUNDS) + 1:
+                cum = 0
+                for bound, n in zip(BUCKET_BOUNDS, raw):
+                    cum += n
+                    blabels = _merge_labels(
+                        f'{{le="{format(bound, "g")}"}}', extra)
+                    add(pname, "histogram", f"{pname}_bucket{blabels} {cum}")
+                inf_labels = _merge_labels('{le="+Inf"}', extra)
+                add(pname, "histogram",
+                    f"{pname}_bucket{inf_labels} {cum + raw[-1]}")
+                kind = "histogram"
+            else:
+                for q, key in (("0.5", "p50"), ("0.9", "p90"),
+                               ("0.99", "p99")):
+                    if h.get(key) is not None:
+                        qlabels = _merge_labels(f'{{quantile="{q}"}}', extra)
+                        add(pname, "summary", f"{pname}{qlabels} {h[key]}")
+                kind = "summary"
+            add(pname, kind,
                 f"{pname}_sum{_merge_labels('', extra)} {h.get('sum', 0)}")
-            add(pname, "summary",
+            add(pname, kind,
                 f"{pname}_count{_merge_labels('', extra)} "
                 f"{h.get('count', 0)}")
 
@@ -375,7 +423,7 @@ def render_prometheus(snap: dict | None = None, *,
         emit(snapshot() if snap is None else snap)
 
     lines: list[str] = []
-    kind_order = {"counter": 0, "gauge": 1, "summary": 2}
+    kind_order = {"counter": 0, "gauge": 1, "summary": 2, "histogram": 2}
     for family in sorted(fams, key=lambda f: (kind_order[fams[f]["type"]],
                                               f)):
         fam = fams[family]

@@ -68,6 +68,7 @@ import json
 import os
 import threading
 import time
+import zlib
 from collections import deque
 from contextlib import contextmanager
 
@@ -78,6 +79,17 @@ TRACE_BUFFER_ENV = "CME213_TRACE_BUFFER"
 #: cross-process trace context a launcher exports to its children:
 #: JSON ``{"trace_id": str, "parent_span_id": str|null}``
 TRACE_CONTEXT_ENV = "CME213_TRACE_CONTEXT"
+#: truthy -> tail-based sampling: request-hop spans are buffered per
+#: request and only written when the tail decision keeps them (slow /
+#: shed / failed / requeued / drift-flagged), so always-on tracing costs
+#: ~0 sink traffic on the happy path
+TRACE_TAIL_ENV = "CME213_TRACE_TAIL"
+#: head-sampling rate (0..1): this deterministic fraction of requests
+#: bypasses the tail buffer entirely and is always kept
+TRACE_HEAD_RATE_ENV = "CME213_TRACE_HEAD_RATE"
+#: explicit "slow" latency threshold (ms) for the tail keep decision;
+#: unset means latency alone never forces a keep
+TRACE_TAIL_SLOW_MS_ENV = "CME213_TRACE_TAIL_SLOW_MS"
 
 #: Known event names -> required fields (beyond the automatic
 #: event/t/pid/rank/incarnation/trace tags).  ``tests/test_telemetry.py``
@@ -190,6 +202,11 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "chaos-shrunk": ("campaign", "from_clauses", "to_clauses", "cocktail"),
     # flight recorder (core/flight.py)
     "flight-dump": ("reason", "path", "events"),
+    # wall-clock alignment (this module + serve/transport.py): one per
+    # completed ping-train sync; offset_ms is "peer wall clock minus
+    # mine", err_ms the midpoint-of-RTT uncertainty bound
+    "clock-offset": ("peer_pid", "offset_ms", "err_ms", "rtt_ms",
+                     "samples"),
     # telemetry itself
     "span-begin": ("span", "id", "parent"),
     "span-end": ("span", "id", "parent", "ms"),
@@ -373,9 +390,18 @@ def record_event(event: str, **fields) -> dict:
     process tags (explicit fields win, e.g. the launcher reporting on a
     worker's rank).  Sink writes reuse one cached handle and flush per line, so a
     rank hard-killed mid-solve (``os._exit``) loses nothing it recorded.
+
+    A ``_tail=<key>`` kwarg (used by the request-hop spans) diverts the
+    record into the per-request tail-sampling buffer instead — it is
+    withheld from the buffer and sink until :func:`tail_decide` keeps or
+    drops the request, and never appears as a record field.
     """
+    tail_key = fields.pop("_tail", None)
     rec = {"event": event, "t": round(time.time(), 6),
            **_proc_tags(), **fields}
+    if tail_key is not None:
+        _tail_defer(str(tail_key), rec)
+        return rec
     with _LOCK:
         _buffer().append(rec)
         f = _sink_file()
@@ -408,9 +434,136 @@ def clear_events() -> None:
         _EVENTS = deque()
         _BUFFER_CONFIGURED = False
         _COMPILE_COUNTS.clear()
+        _TAIL_BUFFERS.clear()
     from . import programs
 
     programs.reset()
+
+
+# ------------------------------------------------- tail-based sampling
+
+#: per-request deferred hop-span records, keyed by a process-unique
+#: request key; flushed (kept) or discarded (dropped) by ``tail_decide``
+_TAIL_BUFFERS: dict[str, list] = {}
+_TAIL_ATEXIT_INSTALLED = False
+
+
+def tail_enabled() -> bool:
+    """Whether tail-based sampling is on (``CME213_TRACE_TAIL`` truthy)."""
+    raw = os.environ.get(TRACE_TAIL_ENV, "")
+    return raw.strip().lower() not in ("", "0", "false", "no", "off")
+
+
+def head_keep(key) -> bool:
+    """Deterministic head-sampling decision for a request: a stable
+    ``CME213_TRACE_HEAD_RATE`` fraction of keys (hashed with the trace
+    id, so reruns under one trace are reproducible) bypasses the tail
+    buffer and is always written."""
+    raw = os.environ.get(TRACE_HEAD_RATE_ENV, "")
+    try:
+        rate = float(raw) if raw.strip() else 0.0
+    except ValueError:
+        rate = 0.0
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    h = zlib.crc32(f"{trace_id()}:{key}".encode()) / 0xFFFFFFFF
+    return h < rate
+
+
+def tail_slow_threshold_ms() -> float | None:
+    """The explicit "slow" latency keep-threshold, or None when unset."""
+    raw = os.environ.get(TRACE_TAIL_SLOW_MS_ENV, "")
+    if not raw.strip():
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def tail_keep_reason(status=None, latency_ms=None, requeues=0,
+                     drift=False) -> str | None:
+    """The tail keep-decision shared by every layer: the reason a
+    request's buffered hops must be kept (``shed``/``failed``/
+    ``requeued``/``drift``/``slow``), or None for the happy-path drop."""
+    if status in ("shed", "failed"):
+        return str(status)
+    if requeues:
+        return "requeued"
+    if drift:
+        return "drift"
+    thresh = tail_slow_threshold_ms()
+    if (thresh is not None and latency_ms is not None
+            and float(latency_ms) > thresh):
+        return "slow"
+    return None
+
+
+def _tail_defer(key: str, rec: dict) -> None:
+    """Park ``rec`` in the per-request buffer until ``tail_decide``."""
+    global _TAIL_ATEXIT_INSTALLED
+    with _LOCK:
+        _TAIL_BUFFERS.setdefault(key, []).append(rec)
+        if not _TAIL_ATEXIT_INSTALLED:
+            atexit.register(_tail_flush_all)
+            _TAIL_ATEXIT_INSTALLED = True
+    from . import metrics
+
+    metrics.counter("trace.sampling.buffered").inc()
+
+
+def tail_pending() -> int:
+    """Number of requests with undecided buffered hops (test hook)."""
+    with _LOCK:
+        return len(_TAIL_BUFFERS)
+
+
+def tail_decide(key, keep: bool, reason: str = "ok") -> int:
+    """Resolve one request's buffered hop spans: flush them to the event
+    buffer/sink in recorded order (``keep``) or discard them.  Returns
+    the number of buffered records resolved (0 for an unknown/undecided
+    key — the decision is idempotent).  Feeds the ``trace.sampling.*``
+    counters that prove the drop rate."""
+    if key is None:
+        return 0
+    with _LOCK:
+        recs = _TAIL_BUFFERS.pop(str(key), None)
+    if recs is None:
+        return 0
+    from . import metrics
+
+    if keep:
+        metrics.counter("trace.sampling.kept").inc()
+        metrics.counter(f"trace.sampling.kept.{reason}").inc()
+        with _LOCK:
+            buf = _buffer()
+            f = _sink_file()
+            for rec in recs:
+                buf.append(rec)
+                if f is not None:
+                    try:
+                        f.write(json.dumps(rec, default=str) + "\n")
+                    except OSError:
+                        pass
+            if f is not None:
+                try:
+                    f.flush()
+                except OSError:
+                    pass
+    else:
+        metrics.counter("trace.sampling.dropped").inc()
+    return len(recs)
+
+
+def _tail_flush_all() -> None:
+    """Atexit safety net: a process dying with undecided requests keeps
+    them — losing the happy path is cheap, losing a crash is not."""
+    with _LOCK:
+        keys = list(_TAIL_BUFFERS)
+    for k in keys:
+        tail_decide(k, keep=True, reason="exit")
 
 
 # ------------------------------------------------------------------ spans
@@ -418,6 +571,24 @@ def clear_events() -> None:
 _SPAN_STACK: contextvars.ContextVar[tuple] = contextvars.ContextVar(
     "cme213_span_stack", default=())
 _SPAN_COUNTER = itertools.count(1)
+_SPAN_PREFIX: str | None = None
+
+
+def _span_prefix() -> str:
+    """The per-process span-id prefix.  A bare pid collides across
+    incarnations sharing one fleet trace (pid reuse after a relaunch) —
+    widen it with the incarnation and a random per-process nonce, minted
+    once so ids stay stable within a process."""
+    global _SPAN_PREFIX
+    if _SPAN_PREFIX is None:
+        inc = int(os.environ.get("CME213_INCARNATION", "0") or 0)
+        _SPAN_PREFIX = (f"{os.getpid():x}-{inc}-"
+                        f"{os.urandom(3).hex()}")
+    return _SPAN_PREFIX
+
+
+def _mint_span_id() -> str:
+    return f"{_span_prefix()}.{next(_SPAN_COUNTER)}"
 
 
 class SpanHandle:
@@ -451,11 +622,118 @@ def current_span_id() -> str | None:
     return stack[-1] if stack else None
 
 
+class OpenSpan:
+    """A manually-closed span for request hops that begin and end on
+    different threads (submit on the caller, completion on a receiver
+    loop) — no contextvar stack, the parent is wired explicitly.
+    ``end`` is idempotent and returns the duration; hop durations feed
+    both ``span.<name>.ms`` and, for ``serve.hop.*`` spans, the
+    ``serve.hop.<hop>.ms`` histograms."""
+
+    __slots__ = ("name", "id", "parent", "tail_key", "_tags", "_start",
+                 "_done")
+
+    def __init__(self, name: str, sid: str, parent: str | None,
+                 tail_key: str | None, tags: dict) -> None:
+        self.name = name
+        self.id = sid
+        self.parent = parent
+        self.tail_key = tail_key
+        self._tags = tags
+        self._start = time.perf_counter()
+        self._done = False
+
+    def end(self, **extra) -> float | None:
+        if self._done:
+            return None
+        self._done = True
+        ms = round((time.perf_counter() - self._start) * 1e3, 3)
+        record_event("span-end", span=self.name, id=self.id,
+                     parent=self.parent, ms=ms, _tail=self.tail_key,
+                     **{**self._tags, **extra})
+        from . import metrics
+
+        metrics.histogram(f"span.{self.name}.ms").observe(ms)
+        if self.name.startswith("serve.hop."):
+            metrics.histogram(f"{self.name}.ms").observe(ms)
+        return ms
+
+
+def begin_span(name: str, parent: str | None = None, tail_key=None,
+               head_key=None, **tags) -> OpenSpan:
+    """Open a cross-thread request-hop span (see :class:`OpenSpan`).
+
+    ``parent`` overrides the contextvar/inherited default — this is how
+    a hop parents under a span id carried over the wire.  When tail
+    sampling is on and ``tail_key`` is given (a process-unique request
+    key), the begin/end records are deferred under that key until
+    :func:`tail_decide`; ``head_key`` (default ``tail_key``) is the
+    stable identity hashed for the deterministic head-sampling bypass.
+    ``tags`` ride on both records.
+    """
+    sid = _mint_span_id()
+    if parent is None:
+        stack = _SPAN_STACK.get()
+        parent = stack[-1] if stack else inherited_parent_id()
+    key = None
+    if tail_key is not None and tail_enabled():
+        hk = head_key if head_key is not None else tail_key
+        if not head_keep(hk):
+            key = str(tail_key)
+    record_event("span-begin", span=name, id=sid, parent=parent,
+                 _tail=key, **tags)
+    return OpenSpan(name, sid, parent, key, tags)
+
+
+# --------------------------------------------------- clock alignment
+
+class ClockSync:
+    """Per-peer wall-clock offset estimator from ping round trips.
+
+    Each sample is the classic midpoint-of-RTT estimate: with local send
+    /receive times ``t0``/``t1`` and the peer's reply timestamp ``tr``,
+    ``offset = tr - (t0 + t1)/2`` with uncertainty ``rtt/2`` (the true
+    offset always lies within ±rtt/2 of the estimate, whatever the
+    path asymmetry).  Samples are EWMA-smoothed with one ``alpha`` for
+    both the offset and its error bound, which preserves the invariant
+    ``|offset_ms - true| <= err_ms`` by convexity.  Pure arithmetic over
+    caller-supplied timestamps, so tests drive it from a
+    ``VirtualClock``."""
+
+    __slots__ = ("alpha", "offset_ms", "err_ms", "rtt_ms", "samples")
+
+    def __init__(self, alpha: float = 0.4) -> None:
+        self.alpha = float(alpha)
+        self.offset_ms = 0.0
+        self.err_ms = float("inf")
+        self.rtt_ms = 0.0
+        self.samples = 0
+
+    def update(self, t_send_s: float, t_remote_s: float,
+               t_recv_s: float) -> tuple[float, float]:
+        """Fold one ping exchange (all seconds; local send/recv on one
+        clock, remote timestamp on the peer's).  Returns the smoothed
+        ``(offset_ms, err_ms)``."""
+        rtt_ms = max(0.0, (t_recv_s - t_send_s) * 1e3)
+        off_ms = (t_remote_s - (t_send_s + t_recv_s) / 2.0) * 1e3
+        err_ms = rtt_ms / 2.0
+        if self.samples == 0:
+            self.offset_ms, self.err_ms, self.rtt_ms = off_ms, err_ms, rtt_ms
+        else:
+            a = self.alpha
+            self.offset_ms += a * (off_ms - self.offset_ms)
+            self.err_ms += a * (err_ms - self.err_ms)
+            self.rtt_ms += a * (rtt_ms - self.rtt_ms)
+        self.samples += 1
+        return self.offset_ms, self.err_ms
+
+
 @contextmanager
 def span(name: str, **tags):
     """Trace the enclosed block as a ``span-begin``/``span-end`` pair.
 
-    Ids are unique across a gang (``<pid hex>.<counter>``); the parent
+    Ids are unique across a gang and across relaunches
+    (``<pid hex>-<incarnation>-<nonce>.<counter>``); the parent
     link comes from a contextvar stack, so nesting — including across
     threads started inside a span — produces a causal tree ``trace
     summary`` can aggregate.  ``tags`` ride on both records (kernel rung,
@@ -464,7 +742,7 @@ def span(name: str, **tags):
     ``error`` tag when the block raised; the duration also feeds the
     ``span.<name>.ms`` metrics histogram.
     """
-    sid = f"{os.getpid():x}.{next(_SPAN_COUNTER)}"
+    sid = _mint_span_id()
     stack = _SPAN_STACK.get()
     # a root span in a launched child parents under the spawning
     # process's open span (CME213_TRACE_CONTEXT), so a merged multi-rank

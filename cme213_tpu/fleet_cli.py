@@ -26,6 +26,26 @@ import sys
 import time
 
 
+def _arm_graceful_shutdown() -> None:
+    """Route SIGTERM — and SIGINT even when inherited as ignored — into
+    KeyboardInterrupt.  Shells start backgrounded jobs (``fleet up ... &``,
+    the CI idiom) with SIGINT set to SIG_IGN, in which case Python never
+    installs its own handler and ``kill -INT`` would be a silent no-op:
+    the fleet would only exit at ``--max-seconds``.  With the handlers
+    armed, a plain ``kill`` tears the fleet down gracefully (stats
+    printed, replicas terminated, sinks flushed)."""
+    import signal
+
+    def _graceful(signum, frame):
+        raise KeyboardInterrupt
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _graceful)
+    except ValueError:  # not the main thread (embedded use)
+        pass
+
+
 def _up_main(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="fleet up",
@@ -101,6 +121,7 @@ def _up_main(argv: list[str]) -> int:
     if args.addr_file:
         with open(args.addr_file, "w") as f:
             f.write(fleet.addr)
+    _arm_graceful_shutdown()
     try:
         deadline = time.monotonic() + args.max_seconds
         while time.monotonic() < deadline:

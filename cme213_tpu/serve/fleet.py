@@ -67,7 +67,12 @@ import time
 from ..core import flight, metrics
 from ..core.faults import KILL_EXIT
 from ..core.resilience import Clock
-from ..core.trace import propagation_env, record_event
+from ..core.trace import (
+    propagation_env,
+    record_event,
+    tail_decide,
+    tail_keep_reason,
+)
 from ..dist.launch import (
     _pump,
     _template_metrics_file,
@@ -88,6 +93,32 @@ from .transport import (
 
 #: sentinel queued to a sender thread to shut it down
 _SENDER_STOP = object()
+
+
+def _finish_ticket(ticket: Ticket, meta: dict) -> None:
+    """Close the front tier's ``serve.hop.route`` span, attach the
+    per-hop breakdown to the response meta (``hops`` rides the result
+    doc to the client as an extra field: wait/dispatch/requeue residency
+    plus the requeue count), and make the front tier's tail-sampling
+    keep/drop call — requeues are only visible here, so "kept because
+    requeued" is this hop's verdict."""
+    hop = ticket.hop
+    if hop is None:
+        return
+    route_ms = hop.end(status=meta.get("status"),
+                       requeues=ticket.requeues)
+    if route_ms is None:
+        return
+    hops = dict(ticket.hop_ms)
+    hops["route_ms"] = route_ms
+    hops["requeues"] = ticket.requeues
+    meta["hops"] = hops
+    if hop.tail_key is not None:
+        reason = tail_keep_reason(status=meta.get("status"),
+                                  latency_ms=route_ms,
+                                  requeues=ticket.requeues)
+        tail_decide(hop.tail_key, keep=reason is not None,
+                    reason=reason or "ok")
 
 
 # ------------------------------------------------------------ replica proc
@@ -132,6 +163,9 @@ class ReplicaChannel:
         self.client = TransportClient(
             addr, connect_timeout_s=connect_timeout_s, shm=shm,
             on_response=self._on_response, on_error=self._on_error)
+        # per-peer clock alignment for the request waterfalls: a few
+        # ping round trips bound this replica's wall-clock offset
+        self.client.sync_clock(samples=3)
 
     def send(self, ticket: Ticket) -> None:
         """Pipeline one ticket; raises on a dead connection (the caller
@@ -164,6 +198,7 @@ class ReplicaChannel:
         with fleet._cv:
             fleet.router.complete(ticket, self.rank)
             fleet._cv.notify_all()
+        _finish_ticket(ticket, meta)
         fleet._observe(meta)
         fleet._deliver(ticket, meta, sections)
 
@@ -698,6 +733,20 @@ def worker_main(argv: list[str]) -> int:
     metrics.counter("fleet.replica_up").inc()
     print(f"fleet worker r{rank}: serving on {ts.addr} "
           f"(incarnation {incarnation()})", flush=True)
+    # the supervisor retires/tears down replicas with SIGTERM
+    # (``proc.terminate()``); route it into KeyboardInterrupt so the
+    # transport closes and buffered trace spans reach the sink instead
+    # of dying with the process
+    import signal
+
+    def _graceful(signum, frame):
+        raise KeyboardInterrupt
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _graceful)
+    except ValueError:
+        pass
     hb = heartbeat_from_env()
     deadline = time.monotonic() + args.max_seconds
     try:

@@ -369,6 +369,80 @@ def transport_section(run: dict, before: dict, after: dict) -> dict:
     return section
 
 
+def _waterfall_segments(rtt_ms: float, hops: dict, timing: dict) -> dict:
+    """Decompose one wire request's RTT into disjoint hop segments:
+    client wire+codec, front-tier residency (DRR wait + requeue detours),
+    replica-side waiting (queue/admit/batch-wait), and the kernel run.
+    Segments a layer didn't report (e.g. no front tier on a single
+    TransportServer) are None, not zero."""
+    total = timing.get("total_ms")
+    route = hops.get("route_ms")
+    dispatch = hops.get("dispatch_ms")
+    inner = route if route is not None else total
+    wire = round(max(0.0, rtt_ms - inner), 3) if inner is not None else None
+    front = (round(max(0.0, route - dispatch), 3)
+             if route is not None and dispatch is not None else None)
+    waits = [timing.get(k) for k in ("queue_ms", "admit_ms",
+                                     "batch_wait_ms")]
+    replica_wait = (round(sum(w for w in waits if w is not None), 3)
+                    if any(w is not None for w in waits) else None)
+    return {"wire_ms": wire, "front_ms": front,
+            "replica_wait_ms": replica_wait,
+            "run_ms": timing.get("run_ms")}
+
+
+def waterfall_section(run: dict, before: dict, after: dict) -> dict:
+    """The SLO report's ``waterfall`` section for a ``--transport`` run:
+    per-segment latency percentiles from the hop breakdown each response
+    carries (``res.hops`` — the front tier's route/dispatch/requeue
+    residency — joined with the replica's phase timing), a decomposition
+    of the p99-RTT request naming its **dominant** hop, and the
+    tail-sampling counters that prove the post-hoc drop rate."""
+    rows = []
+    for r in run["results"]:
+        info = getattr(r, "client", None) or {}
+        rtt = info.get("rtt_ms")
+        if rtt is None:
+            continue
+        rows.append((rtt, _waterfall_segments(
+            rtt, getattr(r, "hops", None) or {}, r.timing or {})))
+    section: dict = {}
+    if rows:
+        hops_p: dict[str, dict] = {}
+        for key in ("wire_ms", "front_ms", "replica_wait_ms", "run_ms"):
+            p = _pcts(seg.get(key) for _, seg in rows)
+            if p is not None:
+                hops_p[key] = p
+        if hops_p:
+            section["hops"] = hops_p
+        rows.sort(key=lambda x: x[0])
+        # nearest-rank p99 row: sorted[ceil(0.99 * n) - 1]
+        rtt, seg = rows[min(len(rows) - 1,
+                            max(0, -(-99 * len(rows)) // 100 - 1))]
+        present = {k: v for k, v in seg.items() if v is not None}
+        section["p99"] = {
+            "rtt_ms": round(rtt, 3),
+            "segments": present,
+            "dominant": (max(present, key=present.get)
+                         if present else None),
+        }
+    d = metrics.delta(before, after)["counters"]
+    kept = d.get("trace.sampling.kept", 0)
+    dropped = d.get("trace.sampling.dropped", 0)
+    if d.get("trace.sampling.buffered", 0) or kept or dropped:
+        section["sampling"] = {
+            "buffered": d.get("trace.sampling.buffered", 0),
+            "kept": kept,
+            "dropped": dropped,
+            "keep_rate": (round(kept / (kept + dropped), 4)
+                          if kept + dropped else None),
+            "kept_by_reason": {
+                k[len("trace.sampling.kept."):]: v for k, v in d.items()
+                if k.startswith("trace.sampling.kept.")},
+        }
+    return section
+
+
 def compile_attribution(before: dict, after: dict) -> dict:
     """Per-shape-class compile-vs-run attribution from the metrics delta:
     how much of the pass went to (re)tracing (``compile.<op>.<class>.ms``)
@@ -688,6 +762,33 @@ def format_report(report: dict) -> str:
                          f"{tp['codec_share']:.2%}")
         if tp.get("proto_v1_frames"):
             lines.append(f"  legacy v1 frames: {tp['proto_v1_frames']}")
+    wf = report.get("waterfall")
+    if wf:
+        hops = wf.get("hops") or {}
+        if hops:
+            cells = "  ".join(
+                f"{k.replace('_ms', '')} {v['p50']}/{v['p99']}"
+                for k, v in hops.items())
+            lines.append(f"waterfall (p50/p99 ms): {cells}")
+        p99 = wf.get("p99")
+        if p99 and p99.get("segments"):
+            cells = "  ".join(f"{k.replace('_ms', '')} {v}"
+                              for k, v in p99["segments"].items())
+            lines.append(f"  p99 request ({p99['rtt_ms']} ms rtt): {cells}"
+                         f"  -> dominant hop: "
+                         f"{(p99['dominant'] or '?').replace('_ms', '')}")
+        samp = wf.get("sampling")
+        if samp:
+            decided = samp["kept"] + samp["dropped"]
+            rate = (f"{samp['keep_rate']:.1%}"
+                    if samp.get("keep_rate") is not None else "-")
+            reasons = ", ".join(
+                f"{k} {v}" for k, v in
+                sorted((samp.get("kept_by_reason") or {}).items())) or "-"
+            lines.append(
+                f"  tail sampling: kept {samp['kept']}/{decided} "
+                f"decided ({rate}), {samp['buffered']} buffered; "
+                f"kept by reason: {reasons}")
     fleet = report.get("fleet")
     if fleet:
         seen = ", ".join(fleet.get("replicas_seen") or []) or "-"
@@ -794,6 +895,11 @@ def main(argv: list[str]) -> int:
                     help="exit nonzero when client encode+decode p99 "
                     "exceeds this fraction of the p99 rtt (the framing-"
                     "overhead gate; needs --transport)")
+    ap.add_argument("--max-trace-keep-rate", type=float, default=None,
+                    help="exit nonzero when tail sampling kept more than "
+                    "this fraction of trace-buffered requests (the "
+                    "sampling drop-rate gate; needs --transport and "
+                    "CME213_TRACE_TAIL=1)")
     ap.add_argument("--job", default=None, metavar="JOB_ID",
                     help="with --transport: submit a durable long job "
                     "before the interactive load and report its fate "
@@ -817,7 +923,11 @@ def main(argv: list[str]) -> int:
                       stub_bytes=args.stub_bytes)
 
     if args.transport:
-        from .transport import StubSolveServer, TransportServer
+        from .transport import (
+            StubSolveServer,
+            TransportClient,
+            TransportServer,
+        )
 
         own_server = None
         addr = args.transport
@@ -831,6 +941,13 @@ def main(argv: list[str]) -> int:
                               poll_interval_s=0.001)).start()
             addr = own_server.addr
         try:
+            # clock alignment for the request waterfalls: bound the
+            # front end's wall-clock offset before any spans are cut
+            try:
+                with TransportClient(addr, timeout_s=5.0) as sync_client:
+                    sync_client.sync_clock(samples=5)
+            except (OSError, ConnectionError, ValueError, TimeoutError):
+                pass
             if args.job:
                 job_section = submit_job_over(addr, args)
             if args.warm:
@@ -846,6 +963,7 @@ def main(argv: list[str]) -> int:
             after = metrics.snapshot()
             report = slo_report(run, before, after)
             report["transport"] = transport_section(run, before, after)
+            report["waterfall"] = waterfall_section(run, before, after)
             report["fleet"] = fleet_section(run, addr)
             if args.job:
                 report["job"] = wait_job_over(addr, args, job_section)
@@ -870,6 +988,15 @@ def main(argv: list[str]) -> int:
             if share is None or share > args.max_codec_share:
                 print(f"FAIL: codec share {share} exceeds "
                       f"--max-codec-share={args.max_codec_share}",
+                      file=sys.stderr)
+                rc = 1
+        if args.max_trace_keep_rate is not None:
+            samp = report["waterfall"].get("sampling") or {}
+            rate = samp.get("keep_rate")
+            if rate is None or rate > args.max_trace_keep_rate:
+                print(f"FAIL: trace keep rate {rate} exceeds "
+                      f"--max-trace-keep-rate={args.max_trace_keep_rate} "
+                      f"(tail sampling must drop the happy path)",
                       file=sys.stderr)
                 rc = 1
         return rc

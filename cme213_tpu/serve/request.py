@@ -53,6 +53,15 @@ class SolveRequest:
     # through queue -> batch -> execution -> result, so one id follows
     # the request across the loadgen/server process boundary
     trace_id: str | None = None
+    # wire-carried span context: the upstream hop span id (client root
+    # or front-tier route hop) this request's replica-side hops parent
+    # under, so the merged trace renders as one cross-process tree
+    parent_span_id: str | None = None
+    # open request-hop spans (core.trace.OpenSpan), server-managed:
+    # ``hop`` covers submit -> completion, ``run_hop`` execute ->
+    # completion; both end with the result (or the shed/fail path)
+    hop: object = None
+    run_hop: object = None
 
     def timing(self) -> dict:
         """Phase breakdown in ms (``queue``/``admit``/``batch_wait``/

@@ -46,7 +46,7 @@ from dataclasses import dataclass, field
 
 from ..core import metrics
 from ..core.resilience import CircuitBreaker, Clock
-from ..core.trace import record_event
+from ..core.trace import begin_span, record_event
 
 #: breaker identity for replica routing failures
 ROUTE_OP = "fleet.route"
@@ -74,6 +74,15 @@ class Ticket:
     # reply handle (connection, wire request id) replaces the Event
     sections: list = field(default_factory=list)
     reply: object = None
+    # request-hop spans (core.trace.OpenSpan): ``hop`` covers the whole
+    # front-tier residency (parented under the client's wire-carried
+    # span), ``dispatch_hop`` one assignment attempt, ``wait_hop`` a
+    # requeue detour; ``hop_ms`` collects closed-hop durations for the
+    # response's waterfall breakdown
+    hop: object = None
+    dispatch_hop: object = None
+    wait_hop: object = None
+    hop_ms: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -230,12 +239,31 @@ class Router:
         tenant = doc.get("tenant", "default")
         t = Ticket(seq=next(self._seq), op=doc.get("op", "?"),
                    tenant=tenant, doc=doc)
+        # open the front-tier residency hop under the client's
+        # wire-carried span, then rewrite the forwarded context so the
+        # replica's hops parent under this one — the Dapper chain
+        t.hop = begin_span("serve.hop.route",
+                           parent=doc.get("parent_span"),
+                           tail_key=f"t{t.seq}", head_key=t.seq,
+                           **self._hop_tags(t))
+        doc["parent_span"] = t.hop.id
         if tenant not in self._backlogs:
             self._backlogs[tenant] = deque()
             self._deficit[tenant] = 0.0
             self._tenant_order.append(tenant)
         self._backlogs[tenant].append(t)
         return t
+
+    def _hop_tags(self, ticket: Ticket, **extra) -> dict:
+        """Common tags for a ticket's hop spans; the request's own trace
+        id (carried in the doc) overrides this process's, so every hop
+        of one request lands on one trace id across the fleet."""
+        tags = {"rid": ticket.seq, "op": ticket.op,
+                "tenant": ticket.tenant, **extra}
+        tid = ticket.doc.get("trace_id")
+        if tid:
+            tags["trace"] = tid
+        return tags
 
     # -------------------------------------------------------- dispatch
 
@@ -275,6 +303,17 @@ class Router:
             rep.routed += 1
             self._inflight[ticket.seq] = ticket
             metrics.counter("fleet.routed").inc()
+            if ticket.wait_hop is not None:    # the requeue detour ends
+                ms = ticket.wait_hop.end(replica=rep.rank)
+                if ms is not None:
+                    ticket.hop_ms["requeue_ms"] = round(
+                        ticket.hop_ms.get("requeue_ms", 0.0) + ms, 3)
+                ticket.wait_hop = None
+            if ticket.hop is not None:
+                ticket.dispatch_hop = begin_span(
+                    "serve.hop.dispatch", parent=ticket.hop.id,
+                    tail_key=f"t{ticket.seq}", head_key=ticket.seq,
+                    **self._hop_tags(ticket, replica=rep.rank))
             record_event("request-routed", rid=ticket.seq, op=ticket.op,
                          tenant=ticket.tenant, replica=rep.rank)
             return ticket, rep.rank
@@ -294,6 +333,11 @@ class Router:
             rep = self.replicas.get(rank)
             if rep is not None and rep.inflight > 0:
                 rep.inflight -= 1
+            if ticket.dispatch_hop is not None:
+                ms = ticket.dispatch_hop.end()
+                if ms is not None:
+                    ticket.hop_ms["dispatch_ms"] = ms
+                ticket.dispatch_hop = None
         if ok:
             self.breaker.record_success(ROUTE_OP, _rung(rank))
         return live
@@ -321,6 +365,14 @@ class Router:
         self.requeues[from_replica] += 1
         self.total_requeues += 1
         metrics.counter("fleet.requeued").inc()
+        if ticket.dispatch_hop is not None:    # the attempt died partway
+            ticket.dispatch_hop.end(requeued=True)
+            ticket.dispatch_hop = None
+        if ticket.hop is not None:
+            ticket.wait_hop = begin_span(
+                "serve.hop.requeue", parent=ticket.hop.id,
+                tail_key=f"t{ticket.seq}", head_key=ticket.seq,
+                **self._hop_tags(ticket, from_replica=from_replica))
         record_event("request-requeued", rid=ticket.seq, op=ticket.op,
                      tenant=ticket.tenant, from_replica=from_replica)
         q = self._backlogs.setdefault(ticket.tenant, deque())

@@ -57,7 +57,8 @@ from ..core import admission, metrics, numerics
 from ..core.errors import FrameworkError
 from ..core.faults import maybe_drift, maybe_slow
 from ..core.resilience import CircuitBreaker, Clock, with_fallback
-from ..core.trace import (current_span_id, record_event, span,
+from ..core.trace import (begin_span, current_span_id, record_event, span,
+                          tail_decide, tail_keep_reason,
                           trace_id as current_trace_id)
 from .request import (
     ADMISSION,
@@ -162,14 +163,18 @@ class Server:
     # ------------------------------------------------------------ submit
 
     def submit(self, op: str, payload, deadline_ms: float | None = None,
-               tenant: str = "default", trace_id: str | None = None):
+               tenant: str = "default", trace_id: str | None = None,
+               parent_span: str | None = None):
         """Accept (returns the request id) or refuse (returns a SHED
         :class:`SolveResult`) — never blocks, never queues unboundedly.
 
         ``trace_id`` joins the request to an existing cross-process trace
         (a remote caller forwarding its own id); by default the request
         rides this process's trace, so loadgen → queue → batch →
-        execution → result share one process-spanning id."""
+        execution → result share one process-spanning id.  ``parent_span``
+        is the wire-carried upstream hop span id: the accepted request's
+        ``serve.hop.replica`` span parents under it, so the request's
+        replica-side residency joins the caller's waterfall."""
         if op not in self.adapters:
             raise ValueError(f"unknown op {op!r} "
                              f"(serving: {sorted(self.adapters)})")
@@ -197,6 +202,10 @@ class Server:
                               timing=req.timing(), trace_id=req.trace_id)
             self._observe_slo(res)
             return res
+        req.parent_span_id = parent_span
+        req.hop = begin_span("serve.hop.replica", parent=parent_span,
+                             tail_key=f"r{rid}", head_key=rid,
+                             rid=rid, op=op, tenant=tenant, trace=tid)
         if self.on_submit is not None:
             self.on_submit()
         return rid
@@ -213,6 +222,9 @@ class Server:
         res = SolveResult(req.rid, req.op, SHED, reason=DEADLINE,
                           tenant=req.tenant, timing=req.timing(),
                           trace_id=req.trace_id)
+        if req.hop is not None:
+            req.hop.end(status=SHED, reason=DEADLINE)
+            tail_decide(req.hop.tail_key, keep=True, reason="shed")
         self._observe_slo(res)
         return res
 
@@ -320,6 +332,9 @@ class Server:
                     res = SolveResult(r.rid, r.op, SHED, reason=ADMISSION,
                                       tenant=r.tenant, timing=r.timing(),
                                       trace_id=r.trace_id)
+                    if r.hop is not None:
+                        r.hop.end(status=SHED, reason=ADMISSION)
+                        tail_decide(r.hop.tail_key, keep=True, reason="shed")
                     self._observe_slo(res)
                     shed.append(res)
                 return [], shed
@@ -348,6 +363,11 @@ class Server:
         executed = self.clock.now()
         for r in batch:
             r.executed_s = executed
+            if r.hop is not None:
+                r.run_hop = begin_span("serve.hop.run", parent=r.hop.id,
+                                       tail_key=r.hop.tail_key,
+                                       head_key=r.rid, rid=r.rid, op=op,
+                                       trace=r.trace_id)
         try:
             with ctx, span("serve.batch", op=op, shape_class=key,
                            size=len(batch)):
@@ -378,6 +398,11 @@ class Server:
                     r.rid, op, FAILED, reason=str(e)[:200], shape_class=key,
                     batch_size=len(batch), degraded=self.degraded,
                     tenant=r.tenant, timing=timing, trace_id=r.trace_id)
+                if r.run_hop is not None:
+                    r.run_hop.end(error="FrameworkError")
+                if r.hop is not None:
+                    r.hop.end(status=FAILED)
+                    tail_decide(r.hop.tail_key, keep=True, reason="failed")
                 self._observe_slo(res_f)
                 out.append(res_f)
             return out
@@ -416,34 +441,50 @@ class Server:
                 latency_ms=latency_ms, batch_size=len(batch),
                 degraded=self.degraded, tenant=r.tenant, timing=timing,
                 trace_id=r.trace_id)
+            if r.run_hop is not None:
+                r.run_hop.end(rung=res.rung)
+            if r.hop is not None:
+                r.hop.end(status=OK)
             self._observe_slo(res_ok)
             out.append(res_ok)
         # shadow conformance sampling runs LAST: every latency above was
         # already stamped on the clock, so the reference re-execution is
         # off the measured hot path by construction
-        self._shadow(adapter, key, batch, payloads, res, coarse)
+        drifted = self._shadow(adapter, key, batch, payloads, res, coarse)
+        # tail keep-decision at response time, after the drift verdict:
+        # slow/drift-flagged requests keep their buffered hops, the
+        # happy path drops them
+        for r, res_r in zip(batch, out):
+            if r.hop is not None and r.hop.tail_key is not None:
+                reason = tail_keep_reason(status=res_r.status,
+                                          latency_ms=res_r.latency_ms,
+                                          drift=r.rid in drifted)
+                tail_decide(r.hop.tail_key, keep=reason is not None,
+                            reason=reason or "ok")
         metrics.write_exposition()   # no-op unless CME213_METRICS_FILE set
         return out
 
     def _shadow(self, adapter, key: str, batch, payloads, res,
-                coarse) -> None:
+                coarse) -> set:
         """Re-execute a deterministic 1-in-N sample of this batch's
         requests on the reference rung and fold the measured drift into
         the numeric-health observatory (``core/numerics.py``).  Never
         raises into the serving path; skipped entirely when the serving
-        rung *is* the reference (drift against itself is zero)."""
+        rung *is* the reference (drift against itself is zero).  Returns
+        the sampled rids when the comparison went over budget (the
+        drift-flagged keep rule for tail sampling), else an empty set."""
         rate = numerics.shadow_rate()
         if not rate:
-            return
+            return set()
         op = adapter.op
         ref_rung = adapter.rungs(False)[-1]
         if res.rung == ref_rung:
-            return
+            return set()
         picked = [i for i, r in enumerate(batch)
                   if numerics.should_sample(str(r.rid), rate=rate,
                                             trace=r.trace_id)]
         if not picked:
-            return
+            return set()
         try:
             with span("serve.shadow", op=op, shape_class=key,
                       size=len(picked)):
@@ -456,9 +497,12 @@ class Server:
             # take down serving; a crashed reference re-execution only
             # costs this sample
             metrics.counter("numerics.shadow.errors").inc()
-            return
+            return set()
         if self.slo is not None:
             self.slo.observe(drift=summary["over_budget"])
+        if summary.get("over_budget"):
+            return {batch[i].rid for i in picked}
+        return set()
 
     def _update_degraded(self) -> None:
         if self.slo is not None:

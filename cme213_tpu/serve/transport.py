@@ -393,13 +393,17 @@ class FrameServer:
     def control(self, doc: dict) -> dict:
         kind = doc.get("control")
         if kind == "ping":
-            return {"ok": True, "pid": os.getpid(),
+            # "t" is this process's wall clock at reply time: the
+            # client's ClockSync turns ping round trips into a per-peer
+            # offset ± error bound for waterfall clock alignment
+            return {"ok": True, "pid": os.getpid(), "t": time.time(),
                     "rank": os.environ.get("JAX_PROCESS_ID", "main"),
                     "incarnation": incarnation()}
         if kind == "hello":
             # protocol negotiation: we always speak v2; echo it so the
             # client pipelines, and ping fields ride along for free
             return {"ok": True, "proto": wire.VERSION, "pid": os.getpid(),
+                    "t": time.time(),
                     "rank": os.environ.get("JAX_PROCESS_ID", "main"),
                     "incarnation": incarnation()}
         if kind == "stats":
@@ -473,7 +477,8 @@ class TransportServer(FrameServer):
             out = self.server.submit(
                 op, payload, deadline_ms=doc.get("deadline_ms"),
                 tenant=doc.get("tenant", "default"),
-                trace_id=doc.get("trace_id"))
+                trace_id=doc.get("trace_id"),
+                parent_span=doc.get("parent_span"))
             if isinstance(out, SolveResult):         # shed at the door
                 return encode_result(out)
             waiter = [threading.Event(), None]
@@ -504,7 +509,8 @@ class TransportServer(FrameServer):
             out = self.server.submit(
                 op, payload, deadline_ms=meta.get("deadline_ms"),
                 tenant=meta.get("tenant", "default"),
-                trace_id=meta.get("trace_id"))
+                trace_id=meta.get("trace_id"),
+                parent_span=meta.get("parent_span"))
             if isinstance(out, SolveResult):
                 shed = out
             else:
@@ -694,6 +700,12 @@ class StubSolveServer(FrameServer):
 
 # ------------------------------------------------------------ client
 
+#: process-wide connection sequence: rids restart at 1 per connection,
+#: so the client-hop tail-sampling keys need a connection discriminator
+#: to stay unique within the process
+_CONN_SEQ = itertools.count(1)
+
+
 class TransportClient:
     """Transport client; v2 (default) pipelines many requests over one
     connection and supports a same-host shared-memory lane, v1 is the
@@ -731,6 +743,8 @@ class TransportClient:
         self._closing = False
         self._conn: _Conn | None = None
         self._sync = False
+        self._conn_seq = next(_CONN_SEQ)
+        self.clock_sync: trace.ClockSync | None = None
         self.proto = 1
         if proto >= 2:
             self._negotiate(host, port)
@@ -900,10 +914,12 @@ class TransportClient:
         meta, sections, recv_s = hit
         t0 = time.perf_counter()
         res = wire.decode_result(meta, sections)
+        hop = info.pop("_hop", None)
         info["decode_ms"] = (time.perf_counter() - t0) * 1e3
         if "sent_s" in info:
             info["rtt_ms"] = (recv_s - info.pop("sent_s")) * 1e3
         res.client = info
+        self._finish_hop(hop, res=res)
         return res
 
     # -- request surface
@@ -945,16 +961,25 @@ class TransportClient:
             raise RuntimeError("submit/result pipelining requires v2; "
                                "use solve() on a v1 connection")
         t0 = time.perf_counter()
+        rid = next(self._rid)
+        tid = trace_id or trace.trace_id()
+        # the client hop is the waterfall root: its id rides the wire as
+        # ``parent_span`` so every downstream hop (route/dispatch/
+        # replica/run) parents under it across process boundaries
+        hop = trace.begin_span("serve.hop.client",
+                               tail_key=f"c{self._conn_seq}.{rid}",
+                               head_key=rid, rid=rid, op=op,
+                               tenant=tenant, trace=tid)
         sw = wire.SectionWriter()
         doc = {"op": op, "payload": wire.encode_payload(op, payload, sw),
-               "tenant": tenant,
-               "trace_id": trace_id or trace.trace_id()}
+               "tenant": tenant, "trace_id": tid,
+               "parent_span": hop.id}
         if deadline_ms is not None:
             doc["deadline_ms"] = deadline_ms
-        rid = next(self._rid)
         bufs = wire.pack_frame(wire.FT_REQUEST, rid, doc, sw.arrays)
         enc_ms = (time.perf_counter() - t0) * 1e3
-        info = {"encode_ms": enc_ms, "sent_s": time.perf_counter()}
+        info = {"encode_ms": enc_ms, "sent_s": time.perf_counter(),
+                "_hop": hop}
         if self._sync:
             self._inflight[rid] = info
         else:
@@ -972,6 +997,7 @@ class TransportClient:
             else:
                 with self._mu:
                     self._pending.pop(rid, None)
+            self._finish_hop(hop, error="ConnectionError")
             raise ConnectionError("server closed connection")
         return rid
 
@@ -1057,19 +1083,75 @@ class TransportClient:
         with self._mu:
             self._pending.pop(rid, None)
         if not ok:
+            self._finish_hop(waiter[2].pop("_hop", None),
+                             error="TimeoutError")
             raise TimeoutError(f"no response for request {rid}")
         kind = waiter[1][0]
         if kind == "err":
+            self._finish_hop(waiter[2].pop("_hop", None),
+                             error=type(waiter[1][1]).__name__)
             raise waiter[1][1]
         _, meta, sections, recv_s = waiter[1]
         t0 = time.perf_counter()
         res = wire.decode_result(meta, sections)
         info = dict(waiter[2])
+        hop = info.pop("_hop", None)
         info["decode_ms"] = (time.perf_counter() - t0) * 1e3
         if "sent_s" in info:
             info["rtt_ms"] = (recv_s - info.pop("sent_s")) * 1e3
         res.client = info            # transport-side attribution
+        self._finish_hop(hop, res=res)
         return res
+
+    def _finish_hop(self, hop, res: SolveResult | None = None,
+                    error: str | None = None) -> None:
+        """End a ``serve.hop.client`` span and make its tail-sampling
+        call: the client is the last hop to see the request, so the
+        end-to-end keep/drop verdict (slow / shed / failed / requeued)
+        lands here."""
+        if hop is None:
+            return
+        if error is not None:
+            ms, status, requeues = hop.end(error=error), FAILED, 0
+        else:
+            requeues = int((getattr(res, "hops", None) or {})
+                           .get("requeues", 0) or 0)
+            ms, status = hop.end(status=res.status), res.status
+        if ms is None or hop.tail_key is None:
+            return
+        reason = trace.tail_keep_reason(status=status, latency_ms=ms,
+                                        requeues=requeues)
+        trace.tail_decide(hop.tail_key, keep=reason is not None,
+                          reason=reason or "ok")
+
+    def sync_clock(self, samples: int = 5) -> trace.ClockSync | None:
+        """Estimate the server's wall-clock offset from ``samples`` ping
+        round trips (midpoint-of-RTT, EWMA-smoothed) and record it as a
+        ``clock-offset`` event — the edge ``trace waterfall`` uses to
+        shift this peer's hops onto one timeline.  Returns the
+        :class:`~..core.trace.ClockSync` (also kept on ``clock_sync``),
+        or None when the peer predates the ``"t"`` ping field or the
+        connection died mid-sync."""
+        cs = trace.ClockSync()
+        peer_pid = None
+        for _ in range(max(1, int(samples))):
+            t0 = time.time()
+            try:
+                resp = self.control("ping")
+            except (ConnectionError, OSError, TimeoutError):
+                return None
+            t1 = time.time()
+            if not resp.get("ok") or resp.get("t") is None:
+                return None
+            peer_pid = resp.get("pid")
+            cs.update(t0, float(resp["t"]), t1)
+        self.clock_sync = cs
+        trace.record_event("clock-offset", peer_pid=peer_pid,
+                           offset_ms=round(cs.offset_ms, 3),
+                           err_ms=round(cs.err_ms, 3),
+                           rtt_ms=round(cs.rtt_ms, 3),
+                           samples=cs.samples)
+        return cs
 
     def solve(self, op: str, payload, deadline_ms: float | None = None,
               tenant: str = "default",
@@ -1079,12 +1161,24 @@ class TransportClient:
                                            deadline_ms=deadline_ms,
                                            tenant=tenant,
                                            trace_id=trace_id))
+        rid = next(self._rid)
+        tid = trace_id or trace.trace_id()
+        hop = trace.begin_span("serve.hop.client",
+                               tail_key=f"c{self._conn_seq}.{rid}",
+                               head_key=rid, rid=rid, op=op,
+                               tenant=tenant, trace=tid)
         doc = {"op": op, "payload": encode_payload(op, payload),
-               "tenant": tenant,
-               "trace_id": trace_id or trace.trace_id()}
+               "tenant": tenant, "trace_id": tid,
+               "parent_span": hop.id}
         if deadline_ms is not None:
             doc["deadline_ms"] = deadline_ms
-        return decode_result(self.request(doc))
+        try:
+            res = decode_result(self.request(doc))
+        except Exception as e:
+            self._finish_hop(hop, error=type(e).__name__)
+            raise
+        self._finish_hop(hop, res=res)
+        return res
 
     def request(self, doc: dict) -> dict:
         """One request doc -> one response doc.  v1: the blocking wire

@@ -31,6 +31,16 @@ frame's section table; the v1 ``{"__nd__": [dtype, shape, b64]}``
 triple is still decoded for compatibility, so a v2 server accepts v1
 payload documents unchanged.
 
+**Span context on the wire.**  Request metas (v2) and request docs (v1)
+carry two optional tracing fields: ``trace_id`` — the cross-process
+trace the request belongs to — and ``parent_span`` — the sender's open
+``serve.hop.*`` span id, which the receiving tier parents its own hop
+under, so one request renders as one tree across client, front tier,
+and replica (``trace waterfall``).  Response metas carry the
+symmetrical extra ``hops`` — the front tier's per-hop residency
+breakdown (wait/dispatch/requeue ms + requeue count) — which rides the
+extras path below and lands on the client's result as ``res.hops``.
+
 Write side: :func:`pack_frame` returns a *buffer list* (header bytes,
 meta bytes, then alternating descriptors and live ``memoryview``s of
 the arrays) pushed through ``socket.sendmsg`` by :func:`send_buffers` —

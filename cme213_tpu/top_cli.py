@@ -4,9 +4,10 @@ The reference watches an MPI job with ``qstat`` plus per-rank timing
 tables printed at the end (hw5); this is the interactive equivalent for a
 gang or serving fleet: per-rank rows (state, step, heartbeat age, last
 span, breaker/degraded flags), fleet gauges (restarts, commits + lag,
-sheds, SLO burns, requests), the hottest spans, and a recent-events
-ribbon — refreshed in place from the per-rank trace sinks that
-``core/collector.py`` tails.
+sheds, SLO burns, requests), the hottest spans, the slowest request
+hops (each line names the rid and trace id ``trace waterfall`` takes),
+and a recent-events ribbon — refreshed in place from the per-rank
+trace sinks that ``core/collector.py`` tails.
 
 Deterministic modes for tests and CI:
 
@@ -136,6 +137,19 @@ def render_top(state: dict, out=None) -> None:
         for name, agg in spans:
             out.write(f"  {name:<28} n={agg['count']:<6} "
                       f"total={agg['total_ms']}ms max={agg['max_ms']}ms\n")
+
+    slowest = state.get("slowest_traces") or []
+    if slowest:
+        out.write("slowest requests (waterfall rid · trace):\n")
+        for e in slowest[:5]:
+            tail = []
+            if e.get("requeues"):
+                tail.append(f"{e['requeues']} requeue(s)")
+            if e.get("status") not in (None, "ok"):
+                tail.append(str(e["status"]))
+            out.write(f"  {e['ms']:>9.1f}ms {e['span']:<18} "
+                      f"rid={e['rid']} trace={e['trace']}"
+                      + (f" [{', '.join(tail)}]" if tail else "") + "\n")
 
     recent = state["recent"][-8:]
     if recent:

@@ -29,7 +29,13 @@ templating).  Three commands:
 - ``export``   — convert traces (including ``merge``-style multi-rank
   sets) to Chrome trace-event JSON loadable in Perfetto or
   ``chrome://tracing``: rank → pid, span nesting depth → tid, spans as
-  B/E pairs, everything else as instant events.
+  B/E pairs, everything else as instant events, and each request's
+  ``serve.hop.*`` chain stitched with flow arrows (s/t/f) across the
+  pid lanes it crossed.
+- ``waterfall`` — one request's hops (matched by rid tag or trace id)
+  reassembled into a cross-process tree, every timestamp shifted onto
+  the front tier's clock via the ``clock-offset`` peer graph with the
+  accumulated ± error bound rendered; ``--json`` for the CI gate.
 - ``regress``  — the bench regression gate (``cme213_tpu.bench.regress``
   under the trace umbrella): fresh sweep CSVs + ``metrics.json`` vs a
   banked baseline directory, machine-readable verdict, nonzero exit
@@ -69,10 +75,14 @@ class TraceParseError(ValueError):
 _BASE_FIELDS = {"event", "t", "pid", "rank", "incarnation", "trace", "_file"}
 
 
-def load_events(paths: list[str]) -> list[dict]:
+def load_events(paths: list[str], *,
+                tolerate_torn: bool = False) -> list[dict]:
     """Parse + time-sort the records of one or many sink files.  Raises
     TraceParseError on any malformed line (parse errors are fatal — see
-    module docstring)."""
+    module docstring) unless ``tolerate_torn`` is set, in which case bad
+    lines are skipped — the ``waterfall`` subcommand uses this because
+    its whole job is reading the sink of a process that may have been
+    SIGKILLed mid-write, leaving a torn final line."""
     events = []
     for path in paths:
         with open(path) as f:
@@ -83,8 +93,12 @@ def load_events(paths: list[str]) -> list[dict]:
                 try:
                     rec = json.loads(line)
                 except ValueError as e:
+                    if tolerate_torn:
+                        continue
                     raise TraceParseError(f"{path}:{lineno}: {e}") from e
                 if not isinstance(rec, dict) or "event" not in rec:
+                    if tolerate_torn:
+                        continue
                     raise TraceParseError(
                         f"{path}:{lineno}: not an event record")
                 rec["_file"] = os.path.basename(path)
@@ -929,6 +943,12 @@ def to_chrome_trace(events: list[dict]) -> dict:
     instant (``i``) event.  Open spans (begun, never ended — a killed
     rank) are dropped so begin/end pairing stays valid for the viewer.
     Timestamps are microseconds relative to the first record.
+
+    Request waterfalls additionally get Chrome *flow* events: the
+    ``serve.hop.*`` spans of one request (grouped by walking parent
+    links to their shared root) are stitched with ``s``/``t``/``f``
+    arrows so Perfetto draws the request's path across the pid lanes it
+    crossed — client to front tier to replica and back.
     """
     ts = [e["t"] for e in events if isinstance(e.get("t"), (int, float))]
     t0 = min(ts) if ts else 0.0
@@ -992,10 +1012,247 @@ def to_chrome_trace(events: list[dict]) -> dict:
             out.append({"name": e["event"], "cat": "event", "ph": "i",
                         "s": "p", "ts": us(t), "pid": pid, "tid": 0,
                         "args": args})
+
+    # request flow arrows: group closed serve.hop.* spans by the root of
+    # their parent chain (one root = one request), then stitch the group
+    # in begin-time order as s → t → ... → f steps.  Each step sits at
+    # its hop's begin inside that hop's pid/tid lane, so the viewer
+    # draws the request hopping across process lanes.
+    def _flow_root(sid):
+        seen = set()
+        while sid not in seen:
+            seen.add(sid)
+            rec = begins.get(sid) or ends.get(sid)
+            parent = rec.get("parent") if rec else None
+            if parent is None or (parent not in begins
+                                  and parent not in ends):
+                return sid
+            sid = parent
+        return sid
+
+    flows = defaultdict(list)
+    for sid, b in begins.items():
+        if (str(b.get("span", "")).startswith("serve.hop.")
+                and sid in ends
+                and isinstance(b.get("t"), (int, float))):
+            flows[_flow_root(sid)].append(b)
+    for flow_id, (root, hops) in enumerate(sorted(flows.items(),
+                                                  key=lambda kv: str(kv[0])),
+                                           start=1):
+        if len(hops) < 2:
+            continue  # a single-hop request has no arrow to draw
+        hops.sort(key=lambda b: b["t"])
+        for i, b in enumerate(hops):
+            ph = "s" if i == 0 else ("f" if i == len(hops) - 1 else "t")
+            ev = {"name": "request", "cat": "flow", "ph": ph,
+                  "id": flow_id, "ts": us(b["t"]),
+                  "pid": _chrome_pid(b), "tid": depth(b.get("id"))}
+            if ph == "f":
+                ev["bp"] = "e"  # bind to the enclosing slice, not the next
+            out.append(ev)
     out.sort(key=lambda ev: ev["ts"])
     meta = [{"name": "process_name", "ph": "M", "pid": pid,
              "args": {"name": label}} for pid, label in sorted(pids.items())]
     return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+# --------------------------------------------------------------- waterfall
+
+def clock_shifts(events: list[dict]) -> dict:
+    """Per-pid clock edges from the ``clock-offset`` events.
+
+    Each event was recorded by ``pid`` after pinging ``peer_pid`` and
+    says: at one instant, the peer's clock read ``offset_ms`` more than
+    ours, give or take ``err_ms`` (half the round trip — the classic
+    Cristian bound).  Returns ``{(recorder, peer): (offset_ms, err_ms)}``
+    keeping the last (most-converged EWMA) sample per pair.
+    """
+    edges: dict = {}
+    for e in events:
+        if e["event"] != "clock-offset":
+            continue
+        a, b = e.get("pid"), e.get("peer_pid")
+        off = e.get("offset_ms")
+        if not isinstance(a, int) or not isinstance(b, int) or \
+                not isinstance(off, (int, float)):
+            continue
+        err = e.get("err_ms")
+        edges[(a, b)] = (float(off),
+                         float(err) if isinstance(err, (int, float)) else 0.0)
+    return edges
+
+
+def resolve_shifts(edges: dict, ref_pid: int) -> dict:
+    """BFS the pid graph: ``{pid: (shift_ms, err_ms)}`` where adding
+    ``shift_ms`` to a timestamp taken on ``pid``'s clock expresses it on
+    ``ref_pid``'s timeline, with ``err_ms`` the accumulated uncertainty
+    along the path (errors add — each synced link contributes its own
+    half-RTT bound).  Unreachable pids are absent: the caller renders
+    them unshifted and flags the missing alignment."""
+    adj = defaultdict(list)
+    for (a, b), (off, err) in edges.items():
+        # recorded: t_peer = t_rec + off.  Walking rec→peer converts a
+        # peer timestamp back by -off; peer→rec converts forward by +off.
+        adj[a].append((b, -off, err))
+        adj[b].append((a, +off, err))
+    shifts = {ref_pid: (0.0, 0.0)}
+    frontier = [ref_pid]
+    while frontier:
+        nxt = []
+        for u in frontier:
+            s, se = shifts[u]
+            for v, d, err in adj[u]:
+                if v not in shifts:
+                    shifts[v] = (s + d, se + err)
+                    nxt.append(v)
+        frontier = nxt
+    return shifts
+
+
+def build_waterfalls(events: list[dict], key: str,
+                     ref_pid: int | None = None) -> dict:
+    """Reassemble per-request waterfalls from the ``serve.hop.*`` spans.
+
+    ``key`` matches a hop's ``rid`` tag or a trace id.  Each matching
+    hop's parent chain is walked to its root (the client hop when the
+    client's sink file is included); every hop sharing that root is one
+    request, rendered as one tree.  Rids restart per process — the same
+    number can name different requests in the client, front-tier, and
+    replica domains — so distinct roots become distinct trees and the
+    caller picks by trace id.
+
+    Timestamps are shifted onto ``ref_pid``'s clock (default: the pid
+    that recorded the front tier's ``serve.hop.route``, else the root's
+    recorder) via the ``clock-offset`` peer graph, carrying the
+    accumulated ± error bound so hop ordering claims are honest about
+    alignment uncertainty.
+    """
+    begins = {e["id"]: e for e in events
+              if e["event"] == "span-begin" and e.get("id") is not None}
+    ends = {e["id"]: e for e in events
+            if e["event"] == "span-end" and e.get("id") is not None}
+
+    def rec(sid):
+        return begins.get(sid) or ends.get(sid)
+
+    hop_ids = [sid for sid in {**begins, **ends}
+               if str(rec(sid).get("span", "")).startswith("serve.hop.")]
+
+    def root_of(sid):
+        seen = set()
+        while sid not in seen:
+            seen.add(sid)
+            parent = (rec(sid) or {}).get("parent")
+            if parent is None or rec(parent) is None:
+                return sid
+            sid = parent
+        return sid
+
+    seeds = [sid for sid in hop_ids
+             if str(rec(sid).get("rid")) == key
+             or str(rec(sid).get("trace")) == key]
+    roots = sorted({root_of(s) for s in seeds}, key=str)
+    by_root = defaultdict(list)
+    for sid in hop_ids:
+        by_root[root_of(sid)].append(sid)
+
+    trees = []
+    for root in roots:
+        members = by_root[root]
+        pids = sorted({rec(s).get("pid") for s in members
+                       if isinstance(rec(s).get("pid"), int)})
+        traces = sorted({str(rec(s).get("trace")) for s in members
+                         if rec(s).get("trace")})
+        route = [s for s in members
+                 if rec(s).get("span") == "serve.hop.route"]
+        ref = ref_pid if ref_pid is not None else \
+            rec((route or [root])[0]).get("pid")
+        shifts = resolve_shifts(clock_shifts(events), ref) \
+            if isinstance(ref, int) else {}
+
+        hops = {}
+        for sid in members:
+            b, e = begins.get(sid), ends.get(sid)
+            r = b or e
+            pid = r.get("pid")
+            shift, err = shifts.get(pid, (0.0, 0.0))
+            ms = e.get("ms") if e and isinstance(e.get("ms"),
+                                                 (int, float)) else None
+            # an end without its begin (ring truncation) still has a
+            # start: rewind its local ms from the end stamp
+            t = b.get("t") if b else (
+                e["t"] - (ms or 0.0) / 1e3
+                if isinstance(e.get("t"), (int, float)) else None)
+            hops[sid] = {
+                "span": r.get("span"), "id": sid,
+                "parent": r.get("parent"), "pid": pid,
+                "rank": r.get("rank"), "rid": r.get("rid"),
+                "start_s": (t + shift / 1e3
+                            if isinstance(t, (int, float)) else None),
+                "dur_ms": ms,
+                "err_ms": round(err, 3),
+                "aligned": pid in shifts,
+                "open": e is None,
+                "requeued": bool((e or {}).get("requeued")),
+            }
+        t0 = min((h["start_s"] for h in hops.values()
+                  if h["start_s"] is not None), default=0.0)
+        for h in hops.values():
+            h["start_ms"] = (round((h.pop("start_s") - t0) * 1e3, 3)
+                             if h["start_s"] is not None
+                             else h.pop("start_s"))
+        children = defaultdict(list)
+        for sid, h in hops.items():
+            if sid != root:
+                children[h["parent"]].append(sid)
+        for kids in children.values():
+            kids.sort(key=lambda s: (hops[s]["start_ms"]
+                                     if hops[s]["start_ms"] is not None
+                                     else float("inf"), str(s)))
+
+        ordered = []
+
+        def _walk(sid, depth):
+            h = dict(hops[sid])
+            h["depth"] = depth
+            ordered.append(h)
+            for kid in children.get(sid, []):
+                _walk(kid, depth + 1)
+
+        _walk(root, 0)
+        trees.append({"root": root, "ref_pid": ref, "pids": pids,
+                      "trace_ids": traces, "hops": ordered})
+    return {"key": key, "trees": trees}
+
+
+def render_waterfall(doc: dict, out=None) -> None:
+    """Text tree, one per matched request: indented hops with their
+    start on the reference timeline (± the clock-alignment bound when
+    the hop lives on a synced remote pid), duration, and the markers
+    that matter for the zero-loss story (``REQUEUED``, ``[open]``)."""
+    w = (out or sys.stdout).write
+    if not doc["trees"]:
+        w(f"no serve.hop.* spans match rid/trace {doc['key']!r}\n")
+        return
+    for tree in doc["trees"]:
+        w(f"request {doc['key']} trace={','.join(tree['trace_ids']) or '-'} "
+          f"({len(tree['hops'])} hop(s) across {len(tree['pids'])} pid(s), "
+          f"timeline of pid {tree['ref_pid']})\n")
+        for h in tree["hops"]:
+            start = (f"+{h['start_ms']:.3f}" if h["start_ms"] is not None
+                     else "?")
+            err = ""
+            if h["err_ms"] and h["aligned"]:
+                err = f" ±{h['err_ms']:.3f}"
+            elif not h["aligned"]:
+                err = " ±?"  # pid never clock-synced against the ref
+            dur = (f" {h['dur_ms']:.3f}ms" if h["dur_ms"] is not None
+                   else " [open]")
+            tags = f" rid={h['rid']}" if h.get("rid") is not None else ""
+            if h["requeued"]:
+                tags += " REQUEUED"
+            w(f"  {'  ' * h['depth']}{h['span']:<{max(2, 24 - 2 * h['depth'])}}"
+              f" pid {h['pid']} {start}{err}ms{dur}{tags}\n")
 
 
 # ------------------------------------------------------------------ flight
@@ -1155,6 +1412,20 @@ def main(argv: list[str] | None = None) -> int:
     p_ex.add_argument("--out", default=None,
                       help="write the Chrome trace here (default stdout)")
 
+    p_wf = sub.add_parser("waterfall",
+                          help="one request's hops as a clock-aligned "
+                               "cross-process tree")
+    p_wf.add_argument("rid", help="request id (any hop's rid tag) or a "
+                                  "trace id")
+    p_wf.add_argument("files", nargs="+")
+    p_wf.add_argument("--json", action="store_true",
+                      help="print the waterfall document instead of the "
+                           "text tree (what the CI gate consumes)")
+    p_wf.add_argument("--ref-pid", type=int, default=None,
+                      help="pid whose clock anchors the timeline "
+                           "(default: the front tier's — the pid that "
+                           "recorded serve.hop.route)")
+
     p_rg = sub.add_parser("regress", help="bench regression gate "
                                           "(cme213_tpu.bench.regress)")
     p_rg.add_argument("args", nargs=argparse.REMAINDER,
@@ -1229,7 +1500,8 @@ def main(argv: list[str] | None = None) -> int:
                 out.close()
         return 0
     try:
-        events = load_events(args.files)
+        events = load_events(args.files,
+                             tolerate_torn=(args.cmd == "waterfall"))
     except (TraceParseError, OSError) as e:
         print(f"trace: {e}", file=sys.stderr)
         return 2
@@ -1240,6 +1512,13 @@ def main(argv: list[str] | None = None) -> int:
             print(f"trace: {e}", file=sys.stderr)
             return 2
 
+    if args.cmd == "waterfall":
+        doc = build_waterfalls(events, args.rid, ref_pid=args.ref_pid)
+        if args.json:
+            print(json.dumps(doc, indent=2, default=str))
+        else:
+            render_waterfall(doc)
+        return 0 if doc["trees"] else 1
     if args.cmd == "export":
         doc = to_chrome_trace(events)
         if args.out:

@@ -343,7 +343,7 @@ def test_render_prometheus_fleet_labels_and_rollup():
     assert ('cme213_serve_shed_total{reason="queue-full",rank="r0"} 2'
             in text)
     assert 'cme213_depth{rank="r1"} 3' in text and "cme213_depth 3" in text
-    assert 'cme213_lat_ms{quantile="0.5",rank="r0"} 4.0' in text
+    assert 'cme213_lat_ms_bucket{le="4",rank="r0"} 1' in text
     assert "cme213_lat_ms_count 2" in text      # rollup sums counts
     assert 'cme213_lat_ms_count{rank="r1"} 1' in text
 

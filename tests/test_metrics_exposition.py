@@ -79,7 +79,7 @@ def test_percentile_ring_wraparound_past_keep():
 # ------------------------------------------------------ prometheus render
 
 _TYPE_LINE = re.compile(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
-                        r"(counter|gauge|summary)$")
+                        r"(counter|gauge|summary|histogram)$")
 _HELP_LINE = re.compile(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
 _SAMPLE_LINE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
@@ -127,12 +127,47 @@ def test_render_prometheus_round_trips_format_validator():
     assert samples["cme213_serve_slo_burn"] == 1.5
     assert samples["cme213_world_size"] == 8
     assert not any("last_op" in k or "armed" in k for k in samples)
-    # histograms render as summaries: retained-window quantiles + exact
+    # histograms render natively: cumulative le-labeled buckets + exact
     # sum/count
-    assert samples['cme213_serve_latency_ms{quantile="0.5"}'] == 50.0
-    assert samples['cme213_serve_latency_ms{quantile="0.99"}'] == 99.0
+    assert samples['cme213_serve_latency_ms_bucket{le="64"}'] == 64
+    assert samples['cme213_serve_latency_ms_bucket{le="128"}'] == 100
+    assert samples['cme213_serve_latency_ms_bucket{le="+Inf"}'] == 100
     assert samples["cme213_serve_latency_ms_sum"] == 5050.0
     assert samples["cme213_serve_latency_ms_count"] == 100
+
+
+def test_render_prometheus_histogram_buckets_are_cumulative():
+    for v in (0.1, 0.5, 3.0, 1e6):
+        metrics.histogram("lat.ms").observe(v)
+    samples = _validate(render_prometheus())
+    assert samples['cme213_lat_ms_bucket{le="0.25"}'] == 1
+    assert samples['cme213_lat_ms_bucket{le="0.5"}'] == 2
+    assert samples['cme213_lat_ms_bucket{le="4"}'] == 3
+    assert samples['cme213_lat_ms_bucket{le="32768"}'] == 3
+    assert samples['cme213_lat_ms_bucket{le="+Inf"}'] == 4    # overflow
+    assert samples["cme213_lat_ms_count"] == 4
+    assert "# TYPE cme213_lat_ms histogram" in render_prometheus()
+
+
+def test_render_prometheus_summary_compat_flag(monkeypatch):
+    """``CME213_METRICS_SUMMARY_COMPAT`` restores the historical
+    quantile-summary rendering; bucket-less (older) snapshots fall back
+    to it per metric regardless of the flag."""
+    for v in range(1, 101):
+        metrics.histogram("serve.latency.ms").observe(float(v))
+    monkeypatch.setenv(metrics.SUMMARY_COMPAT_ENV, "1")
+    samples = _validate(render_prometheus())
+    assert samples['cme213_serve_latency_ms{quantile="0.5"}'] == 50.0
+    assert samples['cme213_serve_latency_ms{quantile="0.99"}'] == 99.0
+    assert samples["cme213_serve_latency_ms_count"] == 100
+    assert not any("_bucket" in k for k in samples)
+    monkeypatch.delenv(metrics.SUMMARY_COMPAT_ENV)
+    legacy = {"histograms": {"old.ms": {"count": 2, "sum": 3.0,
+                                        "p50": 1.5, "p90": 2.0,
+                                        "p99": 2.0}}}
+    text = render_prometheus(legacy)
+    assert 'cme213_old_ms{quantile="0.5"} 1.5' in text
+    assert "# TYPE cme213_old_ms summary" in text
 
 
 def test_render_prometheus_escapes_label_values():

@@ -1,0 +1,476 @@
+"""Cross-fleet request waterfalls (ISSUE 19): clock-offset estimation,
+waterfall reconstruction/alignment, tail-sampling determinism, and the
+`trace waterfall` / Chrome-flow surfacing.
+
+The load-bearing invariants: the Cristian midpoint estimator never lies
+about its uncertainty (|estimate - true offset| <= err, whatever the
+path asymmetry or jitter), hop ordering on the reconstructed waterfall
+holds once per-pid timestamps are shifted through the clock-offset peer
+graph, and the tail sampler's kept-trace set is a pure function of the
+request outcomes (same trace id + same SLO outcomes => same kept set).
+"""
+
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from cme213_tpu import top_cli, trace_cli
+from cme213_tpu.core import faults, metrics, trace
+from cme213_tpu.core.collector import Collector
+from cme213_tpu.core.resilience import VirtualClock
+from cme213_tpu.core.trace import ClockSync
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    trace.flush_sink()
+    trace.clear_events()
+    trace._TAIL_BUFFERS.clear()
+    metrics.reset()
+    yield
+    trace.flush_sink()
+    trace.clear_events()
+    trace._TAIL_BUFFERS.clear()
+    metrics.reset()
+    faults.reset()
+
+
+# ----------------------------------------------------- clock estimation
+
+def test_clocksync_symmetric_exchange_recovers_offset():
+    cs = ClockSync()
+    # peer clock 250 ms ahead; symmetric 2 ms each way
+    off, err = cs.update(10.0, 10.002 + 0.250, 10.004)
+    assert off == pytest.approx(250.0)
+    assert err == pytest.approx(2.0)
+    assert cs.rtt_ms == pytest.approx(4.0)
+    assert cs.samples == 1
+
+
+def test_clocksync_bound_holds_under_asymmetric_jitter():
+    """|estimate - true| <= err after every EWMA fold, driven from a
+    VirtualClock with deterministic asymmetric delays."""
+    rng = random.Random(213)
+    true_off_s = -0.075  # peer clock 75 ms behind
+    clk = VirtualClock(start=100.0)
+    cs = ClockSync()
+    for _ in range(50):
+        t0 = clk.now()
+        clk.advance(rng.uniform(0.001, 0.005))      # request leg
+        t_remote = clk.now() + true_off_s           # peer stamps its clock
+        clk.advance(rng.uniform(0.0005, 0.012))     # slower, jittery reply
+        t1 = clk.now()
+        off, err = cs.update(t0, t_remote, t1)
+        assert abs(off - true_off_s * 1e3) <= err + 1e-9
+        clk.advance(0.01)
+    assert cs.samples == 50
+    # converged well inside the single-sample worst case
+    assert cs.err_ms < 8.5
+
+
+def test_clocksync_ewma_damps_an_rtt_spike():
+    cs = ClockSync()
+    for i in range(5):
+        t0 = float(i)
+        cs.update(t0, t0 + 0.001 + 0.050, t0 + 0.002)  # clean: +50 ms
+    before = cs.offset_ms
+    # one wildly asymmetric 100 ms round trip
+    cs.update(10.0, 10.099 + 0.050, 10.100)
+    assert abs(cs.offset_ms - before) < 25.0  # alpha-damped, not adopted
+    assert abs(cs.offset_ms - 50.0) <= cs.err_ms
+
+
+# ------------------------------------------------ waterfall reconstruction
+
+def _rec(event, span, sid, parent, pid, t, trace_id="T", **tags):
+    return {"event": event, "span": span, "id": sid, "parent": parent,
+            "pid": pid, "rank": None, "incarnation": 0, "trace": trace_id,
+            "t": t, **tags}
+
+
+def _skewed_fleet_events():
+    """One requeued request across three pids with big clock skew.
+
+    Front tier (pid 200) is the reference.  The client's clock (pid 100)
+    runs 2 s AHEAD — raw timestamps would order the client hop after
+    everything it caused — and the replica's (pid 300) runs 500 ms
+    behind.  True on-the-front-tier times are encoded below; each
+    record's ``t`` is in its own pid's skewed clock.
+    """
+    c = 2.0     # client clock = front + 2.0 s
+    r = -0.5    # replica clock = front - 0.5 s
+    evs = [
+        {"event": "clock-offset", "pid": 100, "rank": None,
+         "incarnation": 0, "trace": "T", "t": 0.9 + c, "peer_pid": 200,
+         "offset_ms": -2000.0, "err_ms": 1.5, "rtt_ms": 3.0, "samples": 5},
+        {"event": "clock-offset", "pid": 200, "rank": None,
+         "incarnation": 0, "trace": "T", "t": 0.95, "peer_pid": 300,
+         "offset_ms": -500.0, "err_ms": 2.0, "rtt_ms": 4.0, "samples": 3},
+        _rec("span-begin", "serve.hop.client", "c.1", None, 100,
+             1.000 + c, rid=7),
+        _rec("span-begin", "serve.hop.route", "f.1", "c.1", 200,
+             1.010, rid=3),
+        _rec("span-begin", "serve.hop.dispatch", "f.2", "f.1", 200,
+             1.012, rid=3),
+        _rec("span-end", "serve.hop.dispatch", "f.2", "f.1", 200,
+             1.015, ms=3.0, rid=3, requeued=True),
+        _rec("span-begin", "serve.hop.requeue", "f.3", "f.1", 200,
+             1.015, rid=3),
+        _rec("span-end", "serve.hop.requeue", "f.3", "f.1", 200,
+             1.030, ms=15.0, rid=3),
+        _rec("span-begin", "serve.hop.dispatch", "f.4", "f.1", 200,
+             1.030, rid=3),
+        _rec("span-begin", "serve.hop.replica", "r.1", "f.1", 300,
+             1.032 + r, rid=1),
+        _rec("span-begin", "serve.hop.run", "r.2", "r.1", 300,
+             1.035 + r, rid=1),
+        _rec("span-end", "serve.hop.run", "r.2", "r.1", 300,
+             1.045 + r, ms=10.0, rid=1),
+        _rec("span-end", "serve.hop.replica", "r.1", "f.1", 300,
+             1.050 + r, ms=18.0, rid=1),
+        _rec("span-end", "serve.hop.dispatch", "f.4", "f.1", 200,
+             1.052, ms=22.0, rid=3),
+        _rec("span-end", "serve.hop.route", "f.1", "c.1", 200,
+             1.055, ms=45.0, rid=3, requeues=1),
+        _rec("span-end", "serve.hop.client", "c.1", None, 100,
+             1.060 + c, ms=60.0, rid=7),
+    ]
+    return evs
+
+
+def test_waterfall_aligns_hops_across_skewed_clocks():
+    doc = trace_cli.build_waterfalls(_skewed_fleet_events(), "3")
+    assert len(doc["trees"]) == 1
+    tree = doc["trees"][0]
+    assert tree["ref_pid"] == 200          # the front tier anchors time
+    assert tree["pids"] == [100, 200, 300]
+    assert tree["trace_ids"] == ["T"]
+    hops = {h["id"]: h for h in tree["hops"]}
+    assert len(hops) == 7
+    # depths follow the parent chain
+    assert [hops[i]["depth"] for i in ("c.1", "f.1", "f.2", "r.1", "r.2")] \
+        == [0, 1, 2, 2, 3]
+    # shifted starts land on the true front-tier ordering despite the
+    # client's +2 s and the replica's -0.5 s clocks
+    assert hops["c.1"]["start_ms"] == pytest.approx(0.0)
+    assert hops["f.1"]["start_ms"] == pytest.approx(10.0)
+    assert hops["r.1"]["start_ms"] == pytest.approx(32.0)
+    assert hops["r.2"]["start_ms"] == pytest.approx(35.0)
+    # every child starts no earlier than its parent minus the combined
+    # alignment uncertainty of the two pids involved
+    for h in tree["hops"]:
+        parent = hops.get(h["parent"])
+        if parent is not None:
+            slack = h["err_ms"] + parent["err_ms"] + 1e-6
+            assert h["start_ms"] >= parent["start_ms"] - slack
+    # uncertainty is per-link: front-tier hops are exact, remote hops
+    # carry their sync error
+    assert hops["f.1"]["err_ms"] == 0.0
+    assert hops["c.1"]["err_ms"] == pytest.approx(1.5)
+    assert hops["r.2"]["err_ms"] == pytest.approx(2.0)
+    assert all(h["aligned"] for h in tree["hops"])
+    # the requeue shows up where the zero-loss story needs it
+    assert hops["f.2"]["requeued"] is True
+    assert hops["f.3"]["span"] == "serve.hop.requeue"
+
+
+def test_waterfall_unsynced_pid_is_flagged_not_shifted():
+    evs = [e for e in _skewed_fleet_events()
+           if e["event"] != "clock-offset" or e["pid"] != 200]
+    doc = trace_cli.build_waterfalls(evs, "3")
+    hops = {h["id"]: h for h in doc["trees"][0]["hops"]}
+    assert hops["r.1"]["aligned"] is False  # no path to the reference
+    assert hops["c.1"]["aligned"] is True
+
+
+def test_waterfall_rid_domains_yield_separate_trees():
+    """Rids restart per process: one number can name different requests
+    in different tiers.  Distinct parent-chain roots stay distinct."""
+    evs = _skewed_fleet_events() + [
+        _rec("span-begin", "serve.hop.client", "c2.1", None, 100,
+             5.0, trace_id="T2", rid=3),
+        _rec("span-end", "serve.hop.client", "c2.1", None, 100,
+             5.01, trace_id="T2", ms=10.0, rid=3),
+    ]
+    doc = trace_cli.build_waterfalls(evs, "3")
+    assert len(doc["trees"]) == 2
+    traces = {tuple(t["trace_ids"]) for t in doc["trees"]}
+    assert traces == {("T",), ("T2",)}
+
+
+def test_waterfall_matches_by_trace_id_too():
+    doc = trace_cli.build_waterfalls(_skewed_fleet_events(), "T")
+    assert len(doc["trees"]) == 1
+
+
+def test_waterfall_open_hop_survives_reconstruction():
+    """A hop whose end record never landed (SIGKILLed replica) renders
+    as open instead of vanishing."""
+    evs = [e for e in _skewed_fleet_events()
+           if not (e.get("id") == "r.1" and e["event"] == "span-end")]
+    doc = trace_cli.build_waterfalls(evs, "3")
+    hops = {h["id"]: h for h in doc["trees"][0]["hops"]}
+    assert hops["r.1"]["open"] is True and hops["r.1"]["dur_ms"] is None
+    assert hops["r.2"]["open"] is False
+
+
+def test_waterfall_cli_text_and_json(tmp_path, capsys):
+    path = tmp_path / "t.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n"
+                            for e in _skewed_fleet_events()))
+    assert trace_cli.main(["waterfall", "3", str(path)]) == 0
+    text = capsys.readouterr().out
+    assert "serve.hop.client" in text and "REQUEUED" in text
+    assert "±1.5" in text.replace("1.500", "1.5") or "±1.500" in text
+
+    assert trace_cli.main(["waterfall", "3", "--json", str(path)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["trees"][0]["pids"] == [100, 200, 300]
+
+    assert trace_cli.main(["waterfall", "no-such-rid", str(path)]) == 1
+
+
+# ------------------------------------------------------- chrome flow export
+
+def test_export_emits_flow_arrows_across_pid_lanes():
+    doc = trace_cli.to_chrome_trace(_skewed_fleet_events())
+    flows = [e for e in doc["traceEvents"] if e.get("cat") == "flow"]
+    # 7 closed hops in one request: one s, one f, five t steps
+    assert [e["ph"] for e in sorted(flows, key=lambda e: e["ts"])] \
+        == ["s", "t", "t", "t", "t", "t", "f"]
+    assert len({e["id"] for e in flows}) == 1
+    assert flows[-1].get("bp") == "e" or \
+        [e for e in flows if e["ph"] == "f"][0]["bp"] == "e"
+
+
+def test_export_single_hop_request_gets_no_flow():
+    evs = [
+        _rec("span-begin", "serve.hop.client", "c.9", None, 100, 1.0, rid=9),
+        _rec("span-end", "serve.hop.client", "c.9", None, 100, 1.1,
+             ms=100.0, rid=9),
+    ]
+    doc = trace_cli.to_chrome_trace(evs)
+    assert not [e for e in doc["traceEvents"] if e.get("cat") == "flow"]
+
+
+# -------------------------------------------------- tail-sampling determinism
+
+#: synthetic request outcomes: rid -> (status, latency_ms, requeues)
+_OUTCOMES = [
+    ("ok", 10.0, 0), ("ok", 80.0, 0), ("failed", 12.0, 0),
+    ("ok", 15.0, 0), ("ok", 22.0, 1), ("shed", 1.0, 0),
+    ("ok", 9.0, 0), ("ok", 49.9, 0), ("ok", 50.1, 0), ("ok", 30.0, 0),
+]
+
+
+def _drive_tail_once():
+    trace.clear_events()
+    for rid, (status, lat, requeues) in enumerate(_OUTCOMES):
+        hop = trace.begin_span("serve.hop.client", tail_key=f"c1.{rid}",
+                               head_key=rid, rid=rid)
+        hop.end(status=status)
+        reason = trace.tail_keep_reason(status=status, latency_ms=lat,
+                                        requeues=requeues)
+        trace.tail_decide(hop.tail_key, keep=reason is not None,
+                          reason=reason or "ok")
+    assert trace.tail_pending() == 0
+    kept = []
+    for e in trace.events("span-end"):
+        if e.get("span") == "serve.hop.client":
+            kept.append((e["rid"], e["status"]))
+    return sorted(kept)
+
+
+def test_tail_kept_set_is_deterministic(monkeypatch):
+    """Same trace id + same SLO outcomes => identical kept-trace set,
+    run to run — including the hashed head-sampling bypass."""
+    monkeypatch.setenv(trace.TRACE_TAIL_ENV, "1")
+    monkeypatch.setenv(trace.TRACE_CONTEXT_ENV,
+                       json.dumps({"trace_id": "T-fixed"}))
+    monkeypatch.setenv(trace.TRACE_HEAD_RATE_ENV, "0.3")
+    monkeypatch.setenv(trace.TRACE_TAIL_SLOW_MS_ENV, "50")
+    first = _drive_tail_once()
+    second = _drive_tail_once()
+    assert first == second
+    kept_rids = {rid for rid, _ in first}
+    # SLO violators always survive: failed, shed, requeued, slow (>50)
+    assert {1, 2, 4, 5, 8} <= kept_rids
+    # the happy path is actually shed — not everything is kept
+    assert len(kept_rids) < len(_OUTCOMES)
+
+
+def test_tail_head_rate_zero_drops_every_happy_path(monkeypatch):
+    monkeypatch.setenv(trace.TRACE_TAIL_ENV, "1")
+    monkeypatch.setenv(trace.TRACE_CONTEXT_ENV,
+                       json.dumps({"trace_id": "T-fixed"}))
+    monkeypatch.delenv(trace.TRACE_HEAD_RATE_ENV, raising=False)
+    monkeypatch.setenv(trace.TRACE_TAIL_SLOW_MS_ENV, "50")
+    kept = {rid for rid, _ in _drive_tail_once()}
+    assert kept == {1, 2, 4, 5, 8}
+    snap = metrics.snapshot()["counters"]
+    assert snap["trace.sampling.kept"] == 5
+    assert snap["trace.sampling.dropped"] == 5
+    assert snap["trace.sampling.kept.slow"] == 2
+    assert snap["trace.sampling.kept.failed"] == 1
+    assert snap["trace.sampling.kept.shed"] == 1
+    assert snap["trace.sampling.kept.requeued"] == 1
+
+
+# -------------------------------------------------- slowest-traces ribbon
+
+def test_collector_tracks_slowest_request_hops(tmp_path, capsys):
+    path = tmp_path / "s.jsonl"
+    evs = []
+    for rid in range(12):
+        evs.append(_rec("span-end", "serve.hop.client", f"c.{rid}", None,
+                        100, 1.0 + rid * 0.01, ms=float(10 + rid * 10),
+                        rid=rid, status="ok",
+                        requeues=1 if rid == 11 else 0))
+    path.write_text("".join(json.dumps(e) + "\n" for e in evs))
+    coll = Collector([str(path)])
+    coll.poll()
+    state = coll.state()
+    ribbon = state["slowest_traces"]
+    assert len(ribbon) == Collector._SLOWEST_N
+    assert [e["rid"] for e in ribbon] == [11, 10, 9, 8, 7, 6, 5, 4]
+    assert ribbon[0]["ms"] == 120.0 and ribbon[0]["requeues"] == 1
+    assert ribbon[0]["trace"] == "T"  # the waterfall join key rides along
+
+    top_cli.render_top(state)
+    text = capsys.readouterr().out
+    assert "slowest requests" in text
+    assert "rid=11" in text and "1 requeue(s)" in text
+
+
+# ------------------------------------------------------------ e2e fleet arc
+
+def _tolerant_load(path) -> list[dict]:
+    """Parse a sink file skipping torn lines — a SIGKILLed replica may
+    die mid-write, and this test wants its surviving records, not a
+    parse verdict (``trace waterfall`` CI runs use intact files)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "event" in rec:
+                out.append(rec)
+    return out
+
+
+@pytest.mark.slow
+def test_requeued_request_renders_one_aligned_waterfall(
+        tmp_path, monkeypatch):
+    """Two worker processes, SIGKILL one mid-batch: the requeued request
+    renders as ONE waterfall tree spanning the front tier's pid and both
+    replica incarnations' pids, with the requeue hop visible, one trace
+    id, and the replica residency fitting inside the route hop within
+    the clock-alignment error bounds."""
+    from cme213_tpu.serve.fleet import Fleet
+    from cme213_tpu.serve.loadgen import build_mix
+    from cme213_tpu.serve.transport import TransportClient
+
+    monkeypatch.setenv("CME213_FAULTS", "replica-kill:1:1")
+    monkeypatch.setenv(trace.TRACE_FILE_ENV,
+                       str(tmp_path / "wf-{rank}.jsonl"))
+    fleet = Fleet(replicas=2, mix="cipher", warm_requests=2,
+                  max_batch=4).start()
+    try:
+        specs = build_mix("cipher", 16, seed=19, tenants=2)
+        results = [None] * len(specs)
+
+        def client(i, spec):
+            with TransportClient(fleet.addr) as c:
+                results[i] = c.solve(spec.op, spec.payload,
+                                     tenant=spec.tenant)
+
+        threads = [threading.Thread(target=client, args=(i, s),
+                                    daemon=True)
+                   for i, s in enumerate(specs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert all(r is not None and r.status == "ok" for r in results)
+        requeued = trace.events("request-requeued")
+        assert requeued
+    finally:
+        fleet.close()
+    trace.flush_sink()
+
+    # merge this process's records (client + front tier share the test
+    # pid) with every replica sink, torn tails tolerated
+    events = [dict(e) for e in trace.events()]
+    for p in sorted(tmp_path.glob("wf-*.jsonl")):
+        events.extend(_tolerant_load(p))
+
+    rid = str(requeued[0]["rid"])
+    doc = trace_cli.build_waterfalls(events, rid)
+    # rid domains can collide (another requeued request may carry the
+    # same number in a different tier): the request we asked about is
+    # the tree whose requeue hop itself bears this rid
+    trees = [t for t in doc["trees"]
+             if any(h["span"] == "serve.hop.requeue"
+                    and str(h["rid"]) == rid for h in t["hops"])]
+    assert len(trees) == 1, "the requeued rid must render as one tree"
+    tree = trees[0]
+    assert len(tree["trace_ids"]) == 1
+    assert len(tree["hops"]) >= 5
+    assert len(tree["pids"]) >= 3  # front/client pid + both incarnations
+    hops = {h["id"]: h for h in tree["hops"]}
+    by_span = {}
+    for h in tree["hops"]:
+        by_span.setdefault(h["span"], []).append(h)
+    client_hop = by_span["serve.hop.client"][0]
+    route = by_span["serve.hop.route"][0]
+    # the client observed everything the front tier did
+    assert client_hop["dur_ms"] >= route["dur_ms"]
+    # the killed replica's hop survives as an open span on its own pid
+    assert any(h["open"] for h in by_span.get("serve.hop.replica", []))
+    # the served replica attempt fits inside the route hop within the
+    # accumulated clock-alignment error (plus scheduling slack)
+    served = [h for h in by_span.get("serve.hop.replica", [])
+              if not h["open"]]
+    assert served
+    for h in served:
+        assert h["aligned"], "replica pid must be clock-synced"
+        slack = h["err_ms"] + route["err_ms"] + 20.0
+        assert h["start_ms"] >= route["start_ms"] - slack
+        assert (h["start_ms"] + h["dur_ms"]
+                <= route["start_ms"] + route["dur_ms"] + slack)
+
+
+@pytest.mark.slow
+def test_tail_sampling_keeps_under_ten_percent_on_clean_fleet(monkeypatch):
+    """Tail sampling ON, healthy 2-replica fleet, no SLO violations: the
+    front tier + client drop (almost) every trace while every request
+    still succeeds — always-on tracing at ~zero sink cost."""
+    from cme213_tpu.serve.fleet import Fleet
+    from cme213_tpu.serve.loadgen import build_mix
+    from cme213_tpu.serve.transport import TransportClient
+
+    monkeypatch.setenv(trace.TRACE_TAIL_ENV, "1")
+    monkeypatch.delenv(trace.TRACE_HEAD_RATE_ENV, raising=False)
+    fleet = Fleet(replicas=2, mix="cipher", warm_requests=2,
+                  max_batch=4).start()
+    try:
+        before = metrics.snapshot()
+        specs = build_mix("cipher", 30, seed=7, tenants=2)
+        with TransportClient(fleet.addr) as c:
+            for spec in specs:
+                res = c.solve(spec.op, spec.payload, tenant=spec.tenant)
+                assert res.status == "ok"
+        after = metrics.snapshot()
+    finally:
+        fleet.close()
+    d = metrics.delta(before, after)["counters"]
+    kept = d.get("trace.sampling.kept", 0)
+    dropped = d.get("trace.sampling.dropped", 0)
+    assert kept + dropped >= 60  # client + front tier both decided
+    assert kept / (kept + dropped) < 0.10
+    assert trace.tail_pending() == 0
